@@ -1,0 +1,29 @@
+"""lddl_tpu — a TPU-native (JAX/XLA/pjit) language-dataset pipeline.
+
+A ground-up rebuild of the capabilities of NVIDIA LDDL
+(reference: /root/reference, wdykas/LDDL) designed TPU-first:
+
+- Downloaders normalize public corpora into one-document-per-line text shards.
+- A distributed preprocessor sentence-splits, tokenizes, builds BERT/BART
+  pretraining samples, applies static or dynamic MLM masking, and writes
+  sequence-length-binned Parquet shards. Hot per-partition kernels
+  (masking / binning / packing) run as jit+vmap'd JAX on TPU.
+- A deterministic SPMD load balancer equalizes per-shard sample counts.
+- A streaming, epoch-seeded data loader yields globally-sharded ``jax.Array``
+  batches for an arbitrary ``jax.sharding.Mesh`` (data-parallel-group-aware:
+  tensor/pipeline-parallel peers receive identical data) with synchronized
+  per-iteration sequence-bin selection and zero communication.
+
+Layer map (mirrors reference lddl/ layering, see SURVEY.md):
+
+    download/    -> text shards        (ref: lddl/download/)
+    preprocess/  -> parquet shards     (ref: lddl/dask/)
+    balance/     -> balanced shards    (ref: lddl/dask/load_balance.py)
+    loader/      -> device batches     (ref: lddl/torch*, lddl/paddle)
+    ops/         -> TPU kernels for the hot paths (new; TPU-native)
+    models/      -> reference BERT/BART models + train steps (new; the
+                    mock-training harness the reference keeps in benchmarks/)
+    parallel/    -> mesh + multihost coordination (ref: MPI/NCCL usage)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,24 @@
+from .distributed import (
+    Communicator,
+    LocalCommunicator,
+    JaxCommunicator,
+    ThreadGroupCommunicator,
+    get_communicator,
+)
+from .mesh import make_mesh, AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP, DATA_AXES
+
+__all__ = [
+    "Communicator",
+    "LocalCommunicator",
+    "JaxCommunicator",
+    "ThreadGroupCommunicator",
+    "get_communicator",
+    "make_mesh",
+    "AXIS_DP",
+    "AXIS_FSDP",
+    "AXIS_TP",
+    "AXIS_SP",
+    "AXIS_PP",
+    "AXIS_EP",
+    "DATA_AXES",
+]

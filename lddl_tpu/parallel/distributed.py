@@ -1,0 +1,222 @@
+"""Host-level collective communication.
+
+The reference coordinates hosts three different ways — mpi4py for the
+balancer (lddl/dask/load_balance.py:210-223), torch.distributed NCCL for the
+torch loaders (lddl/torch/utils.py:28-62), and Paddle env vars + a hand-built
+static NCCL program for the paddle loader (lddl/paddle/utils.py:31-146).
+
+TPU-native rebuild: ONE tiny communicator interface with pluggable backends.
+The only collectives the whole pipeline needs are sum-allreduce over small
+int64 vectors, max-allreduce, and a barrier — metadata sync, never tensor
+transport (batches never cross hosts; each host feeds its own addressable
+devices).
+
+Backends:
+
+- LocalCommunicator: world of 1; all ops are identity. The default.
+- JaxCommunicator: multi-host via ``jax.distributed`` + on-device psum over
+  whatever backend is initialized (TPU ICI/DCN, or CPU ring for
+  preprocess-only clusters). Replaces MPI_Allreduce / MPI_Barrier.
+- ThreadGroupCommunicator: N SPMD "ranks" as threads in one process, with
+  real barrier semantics — used by the test-suite to exercise multi-rank
+  lockstep algorithms (the fake multi-process harness the reference lacks,
+  SURVEY.md §4).
+"""
+
+import threading
+
+import numpy as np
+
+
+class Communicator:
+    """Interface. Ranks are 0..world_size-1."""
+
+    @property
+    def rank(self):
+        raise NotImplementedError
+
+    @property
+    def world_size(self):
+        raise NotImplementedError
+
+    def barrier(self):
+        raise NotImplementedError
+
+    def allreduce_sum(self, values):
+        """Element-wise sum of an int64 numpy vector across ranks."""
+        raise NotImplementedError
+
+    def allreduce_max(self, values):
+        raise NotImplementedError
+
+
+class LocalCommunicator(Communicator):
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def world_size(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    def allreduce_sum(self, values):
+        return np.array(values, dtype=np.int64, copy=True)
+
+    def allreduce_max(self, values):
+        return np.array(values, dtype=np.int64, copy=True)
+
+
+class JaxCommunicator(Communicator):
+    """Multi-host collectives over jax.distributed.
+
+    Requires ``jax.distributed.initialize()`` to have been called (the CLIs
+    do this when --multihost is passed). Works on TPU pods and on CPU-only
+    preprocess clusters alike: the reduction rides whatever device backend
+    is visible, and the payloads are tiny metadata vectors.
+    """
+
+    def __init__(self):
+        import jax
+        self._jax = jax
+        if jax.process_count() <= 1:
+            raise RuntimeError(
+                "JaxCommunicator requires jax.distributed with >1 process; "
+                "use LocalCommunicator for single-process runs")
+
+    @property
+    def rank(self):
+        return self._jax.process_index()
+
+    @property
+    def world_size(self):
+        return self._jax.process_count()
+
+    def barrier(self):
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("lddl_tpu_barrier")
+
+    def _allreduce(self, values, op):
+        from jax.experimental import multihost_utils
+        values = np.asarray(values, dtype=np.int64)
+        # Ship the vector as raw bytes: JAX canonicalizes int64 arrays to
+        # int32 when jax_enable_x64 is off (the default), which would
+        # silently corrupt counts >= 2^31. uint8 survives canonicalization,
+        # and the actual reduction happens on host at full precision.
+        payload = values.tobytes()
+        gathered = np.asarray(
+            multihost_utils.process_allgather(
+                np.frombuffer(payload, dtype=np.uint8)))
+        per_rank = np.stack([
+            np.frombuffer(row.tobytes(), dtype=np.int64)
+            for row in gathered.reshape(self.world_size, -1)
+        ])
+        return op(per_rank, axis=0).astype(np.int64)
+
+    def allreduce_sum(self, values):
+        return self._allreduce(values, np.sum)
+
+    def allreduce_max(self, values):
+        return self._allreduce(values, np.max)
+
+
+class ThreadGroupCommunicator(Communicator):
+    """N SPMD ranks as threads with real barrier/allreduce semantics.
+
+    Test harness for lockstep algorithms (balancer, censuses). Create the
+    group with :meth:`spawn`, which runs ``fn(comm)`` on every rank-thread
+    and re-raises the first failure.
+    """
+
+    class _Shared:
+
+        def __init__(self, world_size):
+            self.barrier = threading.Barrier(world_size)
+            self.lock = threading.Lock()
+            self.reduce_buf = None
+            self.reduce_result = None
+
+    def __init__(self, rank, world_size, shared):
+        self._rank = rank
+        self._world_size = world_size
+        self._shared = shared
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    def barrier(self):
+        self._shared.barrier.wait()
+
+    def _allreduce(self, values, op):
+        values = np.asarray(values, dtype=np.int64)
+        with self._shared.lock:
+            if self._shared.reduce_buf is None:
+                self._shared.reduce_buf = []
+            self._shared.reduce_buf.append(values)
+        self._shared.barrier.wait()
+        if self._rank == 0:
+            self._shared.reduce_result = op(
+                np.stack(self._shared.reduce_buf), axis=0).astype(np.int64)
+            self._shared.reduce_buf = None
+        self._shared.barrier.wait()
+        # Copy: every rank must own its result so in-place mutation cannot
+        # alias across rank-threads (matching JaxCommunicator semantics).
+        result = self._shared.reduce_result.copy()
+        self._shared.barrier.wait()
+        return result
+
+    def allreduce_sum(self, values):
+        return self._allreduce(values, np.sum)
+
+    def allreduce_max(self, values):
+        return self._allreduce(values, np.max)
+
+    @classmethod
+    def spawn(cls, world_size, fn):
+        """Run ``fn(comm)`` on ``world_size`` rank-threads; returns the list
+        of per-rank return values; re-raises the first exception."""
+        shared = cls._Shared(world_size)
+        results = [None] * world_size
+        errors = [None] * world_size
+
+        def run(rank):
+            try:
+                results[rank] = fn(cls(rank, world_size, shared))
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                errors[rank] = e
+                # Break the barrier so peers don't deadlock.
+                shared.barrier.abort()
+
+        threads = [
+            threading.Thread(target=run, args=(r,)) for r in range(world_size)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for e in errors:
+            if e is not None and not isinstance(e, threading.BrokenBarrierError):
+                raise e
+        for e in errors:
+            if e is not None:
+                raise e
+        return results
+
+
+def get_communicator():
+    """LocalCommunicator unless jax.distributed is up with >1 process."""
+    try:
+        import jax
+    except ImportError:
+        return LocalCommunicator()
+    if jax.process_count() > 1:
+        return JaxCommunicator()
+    return LocalCommunicator()

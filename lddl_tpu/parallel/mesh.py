@@ -1,0 +1,79 @@
+"""Device-mesh conventions.
+
+The reference's only notion of topology is (rank, world_size) plus the
+model-parallel fork's dp_rank (lddl/torch_mp/utils.py:33-51). TPU-native,
+topology is a named ``jax.sharding.Mesh``; the loader derives everything it
+needs (which samples this host must produce) from the mesh + batch sharding
+instead of from NCCL collectives.
+
+Canonical axis names used across lddl_tpu (a subset may be present):
+
+    dp    data parallel          (batch dim)
+    fsdp  fully-sharded DP       (batch dim + param shards)
+    tp    tensor parallel        (hidden dims)
+    sp    sequence/context par.  (sequence dim)
+    pp    pipeline parallel      (layer stages)
+    ep    expert parallel        (MoE experts)
+
+Batches are sharded over DATA_AXES = ('dp', 'fsdp'); all devices that share
+the same (dp, fsdp) coordinate — i.e. TP/PP/SP peers — receive identical
+data, which is exactly the reference's dp_rank contract
+(lddl/torch_mp/bert.py:203-211).
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_DP = "dp"
+AXIS_FSDP = "fsdp"
+AXIS_TP = "tp"
+AXIS_SP = "sp"
+AXIS_PP = "pp"
+AXIS_EP = "ep"
+
+# Mesh axes over which the global batch is sharded.
+DATA_AXES = (AXIS_DP, AXIS_FSDP)
+
+
+def make_mesh(axis_sizes, devices=None):
+    """Build a Mesh from {axis_name: size}; size -1 means "absorb the rest".
+
+    Axis order follows insertion order of ``axis_sizes``. Axes of size 1 are
+    kept — a consistent rank makes sharding rules simpler to write.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis may be -1")
+    known = int(np.prod([s for s in sizes if s != -1]))
+    if -1 in sizes:
+        if n % known != 0:
+            raise ValueError(
+                "cannot infer -1 axis: {} devices not divisible by {}".format(
+                    n, known))
+        sizes[sizes.index(-1)] = n // known
+    total = int(np.prod(sizes))
+    if total != n:
+        raise ValueError(
+            "mesh {} needs {} devices, have {}".format(
+                dict(zip(names, sizes)), total, n))
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=tuple(names))
+
+
+def mesh_data_axes(mesh):
+    """The data axes present in this mesh, in mesh order."""
+    return tuple(a for a in mesh.axis_names if a in DATA_AXES)
+
+
+def data_parallel_size(mesh):
+    """Number of data-parallel groups = product of data-axis sizes."""
+    size = 1
+    for a in mesh_data_axes(mesh):
+        size *= mesh.shape[a]
+    return size

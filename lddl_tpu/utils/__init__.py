@@ -1,0 +1,32 @@
+from .types import File
+from .fs import (
+    mkdir,
+    expand_outdir_and_mkdir,
+    get_all_files_paths_under,
+    get_all_parquets_under,
+    get_all_bin_ids,
+    get_file_paths_for_bin_id,
+    get_num_samples_of_parquet,
+    serialize_np_array,
+    deserialize_np_array,
+    NUM_SAMPLES_CACHE_NAME,
+)
+from .args import attach_bool_arg, parse_str_of_num_bytes
+from . import rng
+
+__all__ = [
+    "File",
+    "mkdir",
+    "expand_outdir_and_mkdir",
+    "get_all_files_paths_under",
+    "get_all_parquets_under",
+    "get_all_bin_ids",
+    "get_file_paths_for_bin_id",
+    "get_num_samples_of_parquet",
+    "serialize_np_array",
+    "deserialize_np_array",
+    "NUM_SAMPLES_CACHE_NAME",
+    "attach_bool_arg",
+    "parse_str_of_num_bytes",
+    "rng",
+]

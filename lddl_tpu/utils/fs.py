@@ -1,0 +1,125 @@
+"""Filesystem, parquet, and shard-naming helpers.
+
+Reference parity: lddl/utils.py (mkdir:32, expand_outdir_and_mkdir:36,
+get_all_files_paths_under:42, get_all_parquets_under:47, get_all_bin_ids:54,
+get_file_paths_for_bin_id:70, get_num_samples_of_parquet:77,
+serialize_np_array:98, deserialize_np_array:105).
+
+The bin-id filename protocol is load-bearing across three stages
+(preprocessor -> balancer -> loader): a shard that belongs to sequence-length
+bin ``k`` carries the *extension* ``.parquet_<k>``; bin ids must be contiguous
+from 0. This module is the single owner of that protocol.
+"""
+
+import io
+import json
+import os
+
+import numpy as np
+import pyarrow.parquet as pq
+
+# Name of the per-directory sample-count cache written by the balancer and
+# consumed by the loader so startup does not need to touch every footer.
+# (ref: lddl/dask/load_balance.py:372-378, lddl/torch/datasets.py:166-187)
+NUM_SAMPLES_CACHE_NAME = ".num_samples.json"
+
+
+def mkdir(d):
+    os.makedirs(d, exist_ok=True)
+
+
+def expand_outdir_and_mkdir(outdir):
+    outdir = os.path.abspath(os.path.expanduser(outdir))
+    mkdir(outdir)
+    return outdir
+
+
+def get_all_files_paths_under(root):
+    """All file paths (recursively) under ``root``, sorted for determinism."""
+    return sorted(
+        os.path.join(dirpath, f)
+        for dirpath, _, filenames in os.walk(root)
+        for f in filenames
+    )
+
+
+def _is_parquet_path(path):
+    name = os.path.basename(path)
+    if name.startswith("."):
+        return False
+    ext = name.split(".")[-1]
+    return ext == "parquet" or ext.startswith("parquet_")
+
+
+def get_all_parquets_under(path):
+    """All parquet shards (binned or not) under ``path``."""
+    return [p for p in get_all_files_paths_under(path) if _is_parquet_path(p)]
+
+
+def get_bin_id_of_path(path):
+    """Bin id encoded in the file extension, or None for unbinned shards."""
+    ext = os.path.basename(path).split(".")[-1]
+    if ext.startswith("parquet_"):
+        suffix = ext[len("parquet_"):]
+        if suffix.isdigit():
+            return int(suffix)
+    return None
+
+def get_all_bin_ids(file_paths):
+    """The sorted set of bin ids present; asserts they are contiguous from 0.
+
+    Contiguity is a pipeline invariant: the loader sizes its per-bin
+    dataloader list by ``max_bin_id + 1`` and the synchronized bin chooser
+    indexes into it. (ref: lddl/utils.py:54-67)
+    """
+    bin_ids = sorted({
+        b for b in (get_bin_id_of_path(p) for p in file_paths) if b is not None
+    })
+    for expected, actual in enumerate(bin_ids):
+        if expected != actual:
+            raise ValueError(
+                "bin ids must be contiguous from 0; found {}".format(bin_ids))
+    return bin_ids
+
+
+def get_file_paths_for_bin_id(file_paths, bin_id):
+    return [p for p in file_paths if get_bin_id_of_path(p) == bin_id]
+
+
+def get_num_samples_of_parquet(path):
+    """Number of rows in a parquet shard, from metadata (no data read)."""
+    return pq.ParquetFile(path).metadata.num_rows
+
+
+def read_num_samples_cache(dir_path):
+    """Load the .num_samples.json cache ({basename: count}) if present."""
+    cache_path = os.path.join(dir_path, NUM_SAMPLES_CACHE_NAME)
+    if os.path.isfile(cache_path):
+        with open(cache_path, "r") as f:
+            return json.load(f)
+    return None
+
+
+def write_num_samples_cache(dir_path, counts):
+    """Store {basename: count} next to the shards. Atomic via rename."""
+    cache_path = os.path.join(dir_path, NUM_SAMPLES_CACHE_NAME)
+    tmp_path = cache_path + ".tmp.{}".format(os.getpid())
+    with open(tmp_path, "w") as f:
+        json.dump(counts, f)
+    os.replace(tmp_path, cache_path)
+
+
+def serialize_np_array(a):
+    """numpy array -> bytes, for storing arrays in parquet columns.
+
+    Used for static-masking outputs (masked positions / labels) which are
+    ragged per-row int arrays. (ref: lddl/utils.py:98-106)
+    """
+    buf = io.BytesIO()
+    np.save(buf, a, allow_pickle=False)
+    return buf.getvalue()
+
+
+def deserialize_np_array(b):
+    buf = io.BytesIO(b)
+    return np.load(buf, allow_pickle=False)

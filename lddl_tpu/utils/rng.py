@@ -1,0 +1,99 @@
+"""Deterministic, counter-based RNG streams.
+
+Reference parity: lddl/random.py:28-55. The reference threads CPython
+``random``-module state blobs through pure functions so many independent
+deterministic streams can share one global generator. We instead give every
+scope its *own* counter-based ``numpy`` Philox generator, keyed by
+``(base_seed, epoch, scope...)`` — the same determinism contract
+(streams are independent, reproducible, and resumable by re-seeding from
+``base_seed + epoch``) without mutable global state. Counter-based keying is
+also what ``jax.random`` uses on-device, so host and device streams follow
+one mental model.
+
+RNG contract (frozen; tests/test_rng.py pins golden values):
+
+- ``world_rng(seed, epoch)``: one stream shared by ALL processes. Every
+  rank draws identical values — this is what makes the epoch-global file
+  shuffle and the per-iteration bin choice communication-free.
+  (ref: lddl/torch/datasets.py:247-249, lddl/torch/dataloader.py:44-50)
+- ``worker_rng(seed, epoch, dp_rank, num_dp_groups, worker, num_workers)``:
+  one stream per (dp_rank, worker). All ranks inside one data-parallel
+  group (i.e. tensor/pipeline-parallel peers) share a stream, so they
+  produce identical batches. (ref: lddl/torch_mp/datasets.py:257-260)
+"""
+
+import hashlib
+import struct
+
+import numpy as np
+
+# Domain-separation tags so world/worker/other streams can never collide
+# even with identical numeric parameters.
+_WORLD_TAG = 0x1DD1_0001
+_WORKER_TAG = 0x1DD1_0002
+_SAMPLE_TAG = 0x1DD1_0003
+
+
+def _generator(*scope):
+    # Philox is counter-based: a 128-bit key fully determines the stream.
+    # Fold the scope tuple into the key with blake2b — stable bytes across
+    # numpy/python versions, collision-resistant across scopes.
+    digest = hashlib.blake2b(
+        struct.pack("<{}Q".format(len(scope)), *(int(s) for s in scope)),
+        digest_size=16).digest()
+    key = np.frombuffer(digest, dtype=np.uint64)
+    return np.random.Generator(np.random.Philox(key=key))
+
+
+def world_rng(base_seed, epoch):
+    """Stream identical on every process for (base_seed, epoch)."""
+    return _generator(_WORLD_TAG, np.uint64(base_seed), np.uint64(epoch), 0)
+
+
+def worker_rng(base_seed, epoch, dp_rank, num_dp_groups, worker, num_workers):
+    """Stream per (epoch, dp_rank, worker); shared by all TP/PP peers of a
+    data-parallel group. Mirrors the reference seed layout
+    ``base_seed + (epoch * num_dp + dp_rank) * workers + worker``
+    (lddl/torch_mp/datasets.py:257-260) but with collision-free keying."""
+    if not (0 <= dp_rank < num_dp_groups):
+        raise ValueError("dp_rank {} out of range [0, {})".format(dp_rank, num_dp_groups))
+    if not (0 <= worker < num_workers):
+        raise ValueError("worker {} out of range [0, {})".format(worker, num_workers))
+    return _generator(
+        _WORKER_TAG,
+        np.uint64(base_seed),
+        np.uint64(epoch),
+        np.uint64(dp_rank) << np.uint64(32) | np.uint64(worker),
+    )
+
+
+def sample_rng(base_seed, *scope):
+    """A one-off stream for preprocessing scopes (e.g. one per input block),
+    keyed by arbitrary non-negative ints."""
+    key = [_SAMPLE_TAG, np.uint64(base_seed)]
+    for s in scope:
+        key.append(np.uint64(s))
+    return _generator(*key)
+
+
+def shuffle(rng, seq):
+    """In-place Fisher-Yates shuffle of a list using ``rng``.
+
+    We implement it explicitly (rather than ``rng.shuffle``) so the consumed
+    random stream is independent of numpy version details for golden tests.
+    """
+    for i in range(len(seq) - 1, 0, -1):
+        j = int(rng.integers(0, i + 1))
+        seq[i], seq[j] = seq[j], seq[i]
+    return seq
+
+
+def choices(rng, population, weights, k=1):
+    """Weighted sampling with replacement (like random.choices)."""
+    w = np.asarray(weights, dtype=np.float64)
+    total = w.sum()
+    if total <= 0:
+        raise ValueError("weights must sum to a positive value")
+    p = w / total
+    idx = rng.choice(len(population), size=k, replace=True, p=p)
+    return [population[int(i)] for i in idx]

@@ -1,0 +1,19 @@
+"""Value types shared across pipeline stages.
+
+Reference parity: lddl/types.py:26-33 (class File).
+"""
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class File:
+    """A data shard on disk together with its sample count.
+
+    The currency of the load balancer and the datasets: every stage that
+    needs to reason about "how many samples live where" passes these around
+    instead of re-reading parquet footers.
+    """
+
+    path: str
+    num_samples: int

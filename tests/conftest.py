@@ -1,0 +1,56 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax import.
+
+This is the fake multi-process harness the reference lacks (SURVEY.md §4):
+mesh/sharding tests run on 8 virtual CPU devices; multi-rank lockstep
+algorithms run on ThreadGroupCommunicator rank-threads.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize may import jax at interpreter startup with
+# JAX_PLATFORMS already pointing at a real accelerator; config.update still
+# works because the backend itself initializes lazily.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.Generator(np.random.Philox(key=[0, 0, 0, 42]))
+
+
+@pytest.fixture
+def tiny_corpus(tmp_path):
+    """A tiny one-document-per-line source corpus (downloader output
+    contract: first whitespace token of each line is the document id,
+    ref lddl/dask/readers.py:131-136)."""
+    source = tmp_path / "source"
+    source.mkdir()
+    docs = []
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+             "lambda mu nu xi omicron pi rho sigma tau upsilon").split()
+    g = np.random.Generator(np.random.Philox(key=[0, 0, 0, 7]))
+    for d in range(48):
+        n_sents = int(g.integers(2, 9))
+        sents = []
+        for _ in range(n_sents):
+            n_words = int(g.integers(4, 14))
+            picks = [words[int(g.integers(0, len(words)))] for _ in range(n_words)]
+            sents.append(" ".join(picks).capitalize() + ".")
+        docs.append("doc-{} {}".format(d, " ".join(sents)))
+    for shard in range(4):
+        with open(source / "{}.txt".format(shard), "w") as f:
+            for line in docs[shard::4]:
+                f.write(line + "\n")
+    return str(tmp_path)

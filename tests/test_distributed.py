@@ -1,0 +1,59 @@
+"""Communicator backends: thread-group SPMD semantics, mesh construction."""
+
+import numpy as np
+import pytest
+
+from lddl_tpu.parallel import (
+    LocalCommunicator,
+    ThreadGroupCommunicator,
+    make_mesh,
+)
+from lddl_tpu.parallel.mesh import data_parallel_size, mesh_data_axes
+
+
+def test_local_communicator():
+    c = LocalCommunicator()
+    assert c.rank == 0 and c.world_size == 1
+    c.barrier()
+    np.testing.assert_array_equal(c.allreduce_sum([1, 2]), [1, 2])
+
+
+def test_thread_group_allreduce():
+    def body(comm):
+        local = np.arange(4) + comm.rank
+        total = comm.allreduce_sum(local)
+        mx = comm.allreduce_max([comm.rank])
+        comm.barrier()
+        return total, mx
+
+    results = ThreadGroupCommunicator.spawn(4, body)
+    expected_sum = np.arange(4) * 4 + sum(range(4))
+    for total, mx in results:
+        np.testing.assert_array_equal(total, expected_sum)
+        assert mx[0] == 3
+
+
+def test_thread_group_error_propagates():
+    def body(comm):
+        if comm.rank == 1:
+            raise RuntimeError("boom")
+        comm.barrier()
+
+    with pytest.raises(RuntimeError, match="boom"):
+        ThreadGroupCommunicator.spawn(3, body)
+
+
+def test_make_mesh_8_devices():
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    assert mesh.shape == {"dp": 2, "tp": 4}
+    assert data_parallel_size(mesh) == 2
+    assert mesh_data_axes(mesh) == ("dp",)
+
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape["dp"] == 4
+
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    assert data_parallel_size(mesh) == 4
+
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 4})

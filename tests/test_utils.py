@@ -1,0 +1,83 @@
+"""Core utils: bin-id filename protocol, parquet helpers, serialization."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.utils import (
+    File,
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_file_paths_for_bin_id,
+    get_num_samples_of_parquet,
+    serialize_np_array,
+    deserialize_np_array,
+)
+from lddl_tpu.utils.fs import (
+    get_bin_id_of_path,
+    read_num_samples_cache,
+    write_num_samples_cache,
+)
+from lddl_tpu.utils.args import parse_str_of_num_bytes
+
+
+def test_bin_id_protocol():
+    assert get_bin_id_of_path("/x/part.0.parquet_3") == 3
+    assert get_bin_id_of_path("/x/part.0.parquet_12") == 12
+    assert get_bin_id_of_path("/x/part.0.parquet") is None
+    assert get_bin_id_of_path("/x/shard-5.parquet_0") == 0
+
+
+def test_bin_ids_contiguous():
+    paths = ["a.parquet_1", "b.parquet_0", "c.parquet_2", "d.parquet_1"]
+    assert get_all_bin_ids(paths) == [0, 1, 2]
+    assert get_file_paths_for_bin_id(paths, 1) == ["a.parquet_1", "d.parquet_1"]
+    with pytest.raises(ValueError):
+        get_all_bin_ids(["a.parquet_1", "b.parquet_2"])
+
+
+def test_parquet_discovery_and_counts(tmp_path):
+    t = pa.table({"A": ["a b c", "d e"], "num_tokens": [3, 2]})
+    p0 = str(tmp_path / "part.0.parquet")
+    p1 = str(tmp_path / "part.1.parquet_0")
+    pq.write_table(t, p0)
+    pq.write_table(t, p1)
+    (tmp_path / "notes.txt").write_text("not a shard")
+    (tmp_path / ".num_samples.json").write_text("{}")
+    found = get_all_parquets_under(str(tmp_path))
+    assert found == [p0, p1]
+    assert get_num_samples_of_parquet(p0) == 2
+
+
+def test_num_samples_cache_roundtrip(tmp_path):
+    counts = {"shard-0.parquet": 10, "shard-1.parquet": 11}
+    write_num_samples_cache(str(tmp_path), counts)
+    assert read_num_samples_cache(str(tmp_path)) == counts
+    assert read_num_samples_cache(str(tmp_path / "missing")) is None
+
+
+def test_np_array_serialization():
+    for a in [np.array([1, 5, 9], dtype=np.int64),
+              np.array([], dtype=np.int32),
+              np.arange(12, dtype=np.uint16)]:
+        b = serialize_np_array(a)
+        assert isinstance(b, bytes)
+        out = deserialize_np_array(b)
+        np.testing.assert_array_equal(a, out)
+        assert a.dtype == out.dtype
+
+
+def test_parse_size():
+    assert parse_str_of_num_bytes("128") == 128
+    assert parse_str_of_num_bytes("4k") == 4096
+    assert parse_str_of_num_bytes("2M") == 2 * 1024**2
+    assert parse_str_of_num_bytes("1G") == 1024**3
+
+
+def test_file_type():
+    f = File("/a/b.parquet", 17)
+    assert f.path == "/a/b.parquet"
+    assert f.num_samples == 17
