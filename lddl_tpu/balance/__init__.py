@@ -1,0 +1,3 @@
+from .balancer import balance_shards, generate_num_samples_cache
+
+__all__ = ["balance_shards", "generate_num_samples_cache"]

@@ -1,0 +1,245 @@
+"""SPMD load balancer: equalize per-shard sample counts to ±1.
+
+Reference parity: lddl/dask/load_balance.py. Same guarantees and output
+contract (``shard-<i>.parquet[_<bin>]`` with every shard holding ``base`` or
+``base+1`` samples, plus a ``.num_samples.json`` cache), with the MPI
+collectives replaced by the lddl_tpu Communicator (jax.distributed on pods,
+no MPI dependency).
+
+Why balancing matters: the loader shards *files* across data-parallel
+groups; equal per-file counts are what keep rank-sharded epochs from
+diverging (ref: lddl/torch/datasets.py:142-156).
+
+Design (ref: load_balance.py:321-369): SPMD-replicated deterministic control
+flow. Every rank computes the identical transfer plan over shard *metadata*;
+exactly one rank — the transfer's owner — performs the parquet I/O for each
+transfer. Row custody always lives on the shared filesystem: every mutation
+is immediately persisted by its owner, so any rank can own the next transfer
+touching that shard after the per-iteration barrier. Communication is one
+sum-allreduce (census) plus one barrier per iteration; rows never ride the
+network directly.
+
+Differences from the reference (improvements, not drift):
+- Transfers move ``min(surplus, deficit)`` against exact per-shard targets
+  instead of halving pair differences, so convergence takes O(1) iterations
+  for typical skew rather than O(log skew).
+- Empty-input edge cases raise clean errors instead of asserting deep in
+  pyarrow.
+"""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ..parallel.distributed import LocalCommunicator
+from ..utils.fs import (
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_file_paths_for_bin_id,
+    get_num_samples_of_parquet,
+    write_num_samples_cache,
+)
+from ..utils.types import File
+
+
+class _Shard:
+    """One output shard: the input Files still feeding it plus an output
+    file accumulating rows it has taken custody of. All ranks track the
+    same metadata; only transfer owners move actual rows."""
+
+    def __init__(self, idx, input_files, out_dir, postfix=""):
+        self.idx = idx
+        self.input_files = list(input_files)
+        self.out_path = os.path.join(
+            out_dir, "shard-{}.parquet{}".format(idx, postfix))
+        self.output_file = None  # File once any rows land in out_path
+
+    @property
+    def num_samples(self):
+        n = sum(f.num_samples for f in self.input_files)
+        if self.output_file is not None:
+            n += self.output_file.num_samples
+        return n
+
+    def _store(self, num_samples, table=None):
+        """Append rows to the output file. ``table`` is given only on the
+        rank doing real I/O; all other ranks mirror the count."""
+        if table is not None:
+            assert table.num_rows == num_samples
+        if self.output_file is None:
+            self.output_file = File(self.out_path, 0)
+        elif table is not None and self.output_file.num_samples > 0:
+            table = pa.concat_tables([pq.read_table(self.out_path), table])
+        self.output_file.num_samples += num_samples
+        if table is not None:
+            assert table.num_rows == self.output_file.num_samples
+            pq.write_table(table, self.out_path)
+
+    def _load(self, num_samples, with_table):
+        """Remove rows, consuming input files from the end first, then the
+        output file. Leftovers of a partially-consumed file are re-stored
+        to the output file (persisted immediately when ``with_table``)."""
+        assert num_samples <= self.num_samples
+        tables = [] if with_table else None
+        while num_samples > 0:
+            from_output = not self.input_files
+            if from_output:
+                src = self.output_file
+                self.output_file = None
+            else:
+                src = self.input_files.pop()
+            take = min(src.num_samples, num_samples)
+            src_table = None
+            if with_table:
+                src_table = pq.read_table(src.path)
+                assert src_table.num_rows == src.num_samples
+                tables.append(src_table.slice(0, take))
+            if take < src.num_samples:
+                self._store(
+                    src.num_samples - take,
+                    table=src_table.slice(take) if with_table else None)
+            elif from_output and with_table:
+                # Output file fully drained: delete it so stale rows cannot
+                # be rediscovered by directory globbing. (A later _store for
+                # this shard recreates the file fresh.)
+                os.remove(src.path)
+            num_samples -= take
+        if with_table:
+            return pa.concat_tables(tables)
+        return None
+
+    def transfer_to(self, other, num_samples, i_am_owner):
+        other._store(num_samples,
+                     table=self._load(num_samples, with_table=i_am_owner))
+
+    def flush(self, i_am_owner):
+        """Fold any remaining input files into the output shard file.
+
+        ``_load`` always pops whole input files (a partially-consumed file's
+        leftover moves to the output file immediately), so everything still
+        listed here is an intact original.
+        """
+        remaining = [f for f in self.input_files if f.num_samples > 0]
+        self.input_files = []
+        if not remaining:
+            return
+        n = sum(f.num_samples for f in remaining)
+        table = None
+        if i_am_owner:
+            table = pa.concat_tables([pq.read_table(f.path) for f in remaining])
+        self._store(n, table=table)
+
+
+def _census(file_paths, comm):
+    """Per-file sample counts: rank-strided footer reads + sum-allreduce.
+    (ref: load_balance.py:226-242)"""
+    counts = [0] * len(file_paths)
+    for i in range(comm.rank, len(file_paths), comm.world_size):
+        counts[i] = get_num_samples_of_parquet(file_paths[i])
+    counts = comm.allreduce_sum(counts)
+    return [File(p, int(n)) for p, n in zip(file_paths, counts)]
+
+
+def _balance_one_set(file_paths, out_dir, num_shards, comm, postfix=""):
+    """Balance one (possibly per-bin) file set into num_shards outputs."""
+    files = _census(file_paths, comm)
+    total = sum(f.num_samples for f in files)
+    if total < num_shards:
+        raise ValueError(
+            "cannot balance {} samples into {} shards; every shard must "
+            "receive at least one sample".format(total, num_shards))
+    base = total // num_shards
+    num_plus_one = total - base * num_shards
+    targets = [base + (1 if i < num_plus_one else 0) for i in range(num_shards)]
+
+    shards = [
+        _Shard(i, files[i::num_shards], out_dir, postfix=postfix)
+        for i in range(num_shards)
+    ]
+
+    transfer_idx = 0
+    for _ in range(num_shards + 2):
+        large = [s for s in shards if s.num_samples > targets[s.idx]]
+        small = [s for s in shards if s.num_samples < targets[s.idx]]
+        if not large and not small:
+            break
+        large.sort(key=lambda s: s.num_samples - targets[s.idx], reverse=True)
+        small.sort(key=lambda s: targets[s.idx] - s.num_samples, reverse=True)
+        for ls, ss in zip(large, small):
+            n = min(ls.num_samples - targets[ls.idx],
+                    targets[ss.idx] - ss.num_samples)
+            if n <= 0:
+                continue
+            ls.transfer_to(
+                ss, n, i_am_owner=(transfer_idx % comm.world_size == comm.rank))
+            transfer_idx += 1
+        comm.barrier()
+    else:
+        raise RuntimeError("balancer failed to converge")
+
+    for s in shards:
+        assert s.num_samples == targets[s.idx], (
+            "shard {} has {} != target {}".format(
+                s.idx, s.num_samples, targets[s.idx]))
+
+    for s in shards:
+        s.flush(i_am_owner=(s.idx % comm.world_size == comm.rank))
+    comm.barrier()
+    return {os.path.basename(s.out_path): int(s.num_samples) for s in shards}
+
+
+def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None):
+    """Balance preprocessor output into ``num_shards`` equal shards (per bin
+    when the input is binned). SPMD: call on every host with identical args.
+
+    Returns {shard_basename: num_samples}; writes .num_samples.json.
+    """
+    comm = comm or LocalCommunicator()
+    log = log or (lambda msg: None)
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    if os.path.isdir(out_dir):
+        stale = [n for n in os.listdir(out_dir) if ".parquet" in n]
+        if stale:
+            raise ValueError(
+                "output dir {} already contains {} shard files (e.g. {}); "
+                "remove them or choose a fresh directory".format(
+                    out_dir, len(stale), stale[0]))
+    os.makedirs(out_dir, exist_ok=True)
+    file_paths = get_all_parquets_under(in_dir)
+    if not file_paths:
+        raise ValueError("no parquet shards under {}".format(in_dir))
+    bin_ids = get_all_bin_ids(file_paths)
+    counts = {}
+    if bin_ids:
+        for b in bin_ids:
+            bin_paths = get_file_paths_for_bin_id(file_paths, b)
+            counts.update(
+                _balance_one_set(bin_paths, out_dir, num_shards, comm,
+                                 postfix="_{}".format(b)))
+            log("balanced bin {}: {} files -> {} shards".format(
+                b, len(bin_paths), num_shards))
+    else:
+        counts.update(_balance_one_set(file_paths, out_dir, num_shards, comm))
+        log("balanced {} files -> {} shards".format(
+            len(file_paths), num_shards))
+    if comm.rank == 0:
+        write_num_samples_cache(out_dir, counts)
+    comm.barrier()
+    return counts
+
+
+def generate_num_samples_cache(path, comm=None):
+    """(Re)build .num_samples.json for a directory of parquet shards.
+    (ref: load_balance.py:428-455)"""
+    comm = comm or LocalCommunicator()
+    file_paths = get_all_parquets_under(path)
+    if not file_paths:
+        raise ValueError("no parquet shards under {}".format(path))
+    files = _census(file_paths, comm)
+    counts = {os.path.basename(f.path): int(f.num_samples) for f in files}
+    if comm.rank == 0:
+        write_num_samples_cache(path, counts)
+    comm.barrier()
+    return counts

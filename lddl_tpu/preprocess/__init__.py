@@ -1,0 +1,26 @@
+from .readers import Block, plan_blocks, read_documents, split_id_text
+from .sentences import split_sentences
+from .tokenizer import get_tokenizer, build_wordpiece_vocab
+from .bert import (
+    BertPretrainConfig,
+    create_pairs_from_document,
+    create_masked_lm_predictions,
+)
+from .binning import bin_id_of_num_tokens, num_bins
+from .runner import run_bert_preprocess
+
+__all__ = [
+    "Block",
+    "plan_blocks",
+    "read_documents",
+    "split_id_text",
+    "split_sentences",
+    "get_tokenizer",
+    "build_wordpiece_vocab",
+    "BertPretrainConfig",
+    "create_pairs_from_document",
+    "create_masked_lm_predictions",
+    "bin_id_of_num_tokens",
+    "num_bins",
+    "run_bert_preprocess",
+]

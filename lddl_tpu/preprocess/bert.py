@@ -1,0 +1,250 @@
+"""BERT pretraining sample construction (NSP pairs + MLM masking).
+
+Reference parity: lddl/dask/bert/pretrain.py:49-441 — itself a port of
+Google BERT's ``create_pretraining_data``. This is an independent
+reimplementation of that public algorithm on top of lddl_tpu's counter-based
+RNG streams (lddl_tpu.utils.rng); the produced distribution matches the
+reference (target-length sampling with ``short_seq_prob``, sentence-chunk
+accumulation, random A/B split point, 50% random-next with segment
+put-back, random front/back pair truncation, 80/10/10 masking), while the
+exact random sequence follows our frozen RNG contract, not CPython's
+Mersenne Twister (SURVEY.md §7 "Byte-identical shards vs TPU RNG").
+
+Output row schema (must match the reference sink,
+lddl/dask/bert/pretrain.py:451-471):
+    A: str                      whitespace-joined WordPiece tokens
+    B: str
+    is_random_next: bool
+    num_tokens: int             len(A) + len(B) + 3 specials
+    masked_lm_positions: bytes  (static masking) serialized np array of
+                                positions into [CLS] A [SEP] B [SEP]
+    masked_lm_labels: str       (static masking) original tokens, joined
+"""
+
+import dataclasses
+
+import numpy as np
+
+from ..utils.fs import serialize_np_array
+from ..utils import rng as lrng
+from .sentences import split_sentences
+
+
+@dataclasses.dataclass
+class BertPretrainConfig:
+    max_seq_length: int = 128
+    short_seq_prob: float = 0.1
+    masking: bool = False
+    masked_lm_ratio: float = 0.15
+    max_predictions_per_seq: int = None  # default: ceil(ratio * max_seq_len)
+    whole_word_masking: bool = False
+    duplicate_factor: int = 5
+
+    def __post_init__(self):
+        if self.max_seq_length < 8:
+            raise ValueError("max_seq_length too small")
+        if self.max_predictions_per_seq is None:
+            self.max_predictions_per_seq = int(
+                np.ceil(self.masked_lm_ratio * self.max_seq_length))
+
+
+def documents_from_texts(texts, tokenizer):
+    """Tokenize raw document texts into documents = lists of token-lists.
+
+    Sentence-splits each text, then WordPiece-tokenizes all sentences in one
+    batched fast-tokenizer call (the reference tokenizes sentence-by-
+    sentence, pretrain.py:77-97; batching is the first of the hot-path wins).
+    Documents that end up empty are dropped.
+    """
+    doc_sentences = [split_sentences(t) for t in texts]
+    flat = [s for sents in doc_sentences for s in sents]
+    if not flat:
+        return []
+    enc = tokenizer(flat, add_special_tokens=False, return_attention_mask=False)
+    documents = []
+    k = 0
+    for sents in doc_sentences:
+        doc = []
+        for _ in sents:
+            tokens = enc.tokens(k)
+            k += 1
+            if tokens:
+                doc.append(tokens)
+        if doc:
+            documents.append(doc)
+    return documents
+
+
+def _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, g):
+    """Randomly truncate the longer of A/B from front or back until the pair
+    fits. (standard BERT truncation; ref pretrain.py:161-178)"""
+    while len(tokens_a) + len(tokens_b) > max_num_tokens:
+        trunc = tokens_a if len(tokens_a) > len(tokens_b) else tokens_b
+        if len(trunc) <= 1:
+            trunc = tokens_b if trunc is tokens_a else tokens_a
+            if len(trunc) <= 1:
+                break
+        if g.random() < 0.5:
+            del trunc[0]
+        else:
+            trunc.pop()
+
+
+def create_masked_lm_predictions(tokens, vocab_words, g, masked_lm_ratio,
+                                 max_predictions_per_seq,
+                                 whole_word_masking=False):
+    """Apply static 80/10/10 MLM masking in place.
+
+    ``tokens`` is the full [CLS] A [SEP] B [SEP] list. Returns
+    (positions, labels): sorted masked positions and their original tokens.
+    """
+    cand_indexes = []
+    for i, token in enumerate(tokens):
+        if token in ("[CLS]", "[SEP]"):
+            continue
+        if (whole_word_masking and cand_indexes
+                and token.startswith("##")):
+            cand_indexes[-1].append(i)
+        else:
+            cand_indexes.append([i])
+
+    lrng.shuffle(g, cand_indexes)
+    num_to_predict = min(max_predictions_per_seq,
+                         max(1, int(round(len(tokens) * masked_lm_ratio))))
+
+    masked = []  # (position, original_token)
+    covered = set()
+    for index_set in cand_indexes:
+        if len(masked) >= num_to_predict:
+            break
+        if len(masked) + len(index_set) > num_to_predict:
+            continue
+        if any(i in covered for i in index_set):
+            continue
+        for i in index_set:
+            covered.add(i)
+            original = tokens[i]
+            r = g.random()
+            if r < 0.8:
+                tokens[i] = "[MASK]"
+            elif r < 0.9:
+                tokens[i] = vocab_words[int(g.integers(0, len(vocab_words)))]
+            # else: keep original
+            masked.append((i, original))
+    masked.sort(key=lambda x: x[0])
+    positions = [p for p, _ in masked]
+    labels = [t for _, t in masked]
+    return positions, labels
+
+
+def create_pairs_from_document(all_documents, document_index, config, g,
+                               vocab_words=None):
+    """Build NSP pair instances from one document.
+
+    ``all_documents``: the block's documents (population for random-next
+    sampling, like the reference's partition). Returns a list of row dicts.
+    """
+    document = all_documents[document_index]
+    max_num_tokens = config.max_seq_length - 3
+    target_seq_length = max_num_tokens
+    if g.random() < config.short_seq_prob:
+        target_seq_length = int(g.integers(2, max_num_tokens + 1))
+
+    instances = []
+    current_chunk = []
+    current_length = 0
+    i = 0
+    while i < len(document):
+        segment = document[i]
+        current_chunk.append(segment)
+        current_length += len(segment)
+        if i == len(document) - 1 or current_length >= target_seq_length:
+            if current_chunk:
+                a_end = 1
+                if len(current_chunk) >= 2:
+                    a_end = int(g.integers(1, len(current_chunk)))
+                tokens_a = []
+                for j in range(a_end):
+                    tokens_a.extend(current_chunk[j])
+
+                tokens_b = []
+                if len(current_chunk) == 1 or g.random() < 0.5:
+                    is_random_next = True
+                    target_b_length = target_seq_length - len(tokens_a)
+                    # Pick a different document (bounded retries mirror the
+                    # standard algorithm; degenerate single-doc blocks fall
+                    # back to self, which truncation keeps well-formed).
+                    random_document_index = document_index
+                    if len(all_documents) > 1:
+                        for _ in range(10):
+                            cand = int(g.integers(0, len(all_documents)))
+                            if cand != document_index:
+                                random_document_index = cand
+                                break
+                    random_document = all_documents[random_document_index]
+                    random_start = int(g.integers(0, len(random_document)))
+                    for j in range(random_start, len(random_document)):
+                        tokens_b.extend(random_document[j])
+                        if len(tokens_b) >= target_b_length:
+                            break
+                    # Put back the unused tail of the chunk.
+                    num_unused_segments = len(current_chunk) - a_end
+                    i -= num_unused_segments
+                else:
+                    is_random_next = False
+                    for j in range(a_end, len(current_chunk)):
+                        tokens_b.extend(current_chunk[j])
+
+                _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, g)
+                if len(tokens_a) >= 1 and len(tokens_b) >= 1:
+                    row = _make_row(tokens_a, tokens_b, is_random_next,
+                                    config, g, vocab_words)
+                    instances.append(row)
+            current_chunk = []
+            current_length = 0
+        i += 1
+    return instances
+
+
+def _make_row(tokens_a, tokens_b, is_random_next, config, g, vocab_words):
+    if config.masking:
+        if not vocab_words:
+            raise ValueError("masking requires vocab_words")
+        tokens = ["[CLS]"] + tokens_a + ["[SEP]"] + tokens_b + ["[SEP]"]
+        positions, labels = create_masked_lm_predictions(
+            tokens, vocab_words, g, config.masked_lm_ratio,
+            config.max_predictions_per_seq, config.whole_word_masking)
+        # Read the (possibly masked) A/B back out of the full sequence.
+        tokens_a = tokens[1:1 + len(tokens_a)]
+        tokens_b = tokens[2 + len(tokens_a):-1]
+        row = {
+            "A": " ".join(tokens_a),
+            "B": " ".join(tokens_b),
+            "is_random_next": bool(is_random_next),
+            "num_tokens": len(tokens_a) + len(tokens_b) + 3,
+            "masked_lm_positions": serialize_np_array(
+                np.asarray(positions, dtype=np.uint16)),
+            "masked_lm_labels": " ".join(labels),
+        }
+    else:
+        row = {
+            "A": " ".join(tokens_a),
+            "B": " ".join(tokens_b),
+            "is_random_next": bool(is_random_next),
+            "num_tokens": len(tokens_a) + len(tokens_b) + 3,
+        }
+    return row
+
+
+def pairs_from_documents(documents, config, g, vocab_words=None):
+    """All pair instances for a block: ``duplicate_factor`` passes over every
+    document (each pass draws fresh randomness -> different pairs/masks,
+    ref pretrain.py:386-402), shuffled within the block."""
+    rows = []
+    for _ in range(config.duplicate_factor):
+        for doc_idx in range(len(documents)):
+            rows.extend(
+                create_pairs_from_document(documents, doc_idx, config, g,
+                                           vocab_words=vocab_words))
+    lrng.shuffle(g, rows)
+    return rows

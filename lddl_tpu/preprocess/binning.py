@@ -1,0 +1,96 @@
+"""Sequence-length binning math + binned parquet sink.
+
+Reference parity: lddl/dask/bert/binning.py — the reference forks Dask's
+to_parquet internals to write one file per (partition, bin) named
+``part.N.parquet_<bin_id>``. We own our sink, so binning is ~40 lines
+instead of a 509-line Dask fork: group rows by bin id, write one table per
+bin with the same naming protocol.
+
+Bin math (must match the loader and balancer):
+    nbins  = target_seq_length // bin_size
+    bin_id = min((num_tokens - 1) // bin_size, nbins - 1)
+so bin k holds sequences of length (k*bin_size, (k+1)*bin_size], and the
+last bin also absorbs any longer stragglers. On TPU this is the shape
+story: pad bin k to (k+1)*bin_size and XLA compiles one program per bin,
+bounded by nbins (SURVEY.md §5 "Long-context").
+"""
+
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+BASE_SCHEMA = {
+    "A": pa.string(),
+    "B": pa.string(),
+    "is_random_next": pa.bool_(),
+    "num_tokens": pa.uint16(),
+}
+MASKING_SCHEMA = {
+    "masked_lm_positions": pa.binary(),
+    "masked_lm_labels": pa.string(),
+}
+
+
+def num_bins(target_seq_length, bin_size):
+    if bin_size is None:
+        return 1
+    if bin_size <= 0 or target_seq_length % bin_size != 0:
+        raise ValueError(
+            "bin_size must divide target_seq_length ({} % {} != 0)".format(
+                target_seq_length, bin_size))
+    return target_seq_length // bin_size
+
+
+def bin_id_of_num_tokens(num_tokens, bin_size, nbins):
+    return min(max(num_tokens - 1, 0) // bin_size, nbins - 1)
+
+
+def make_schema(masking=False, binned=False):
+    fields = dict(BASE_SCHEMA)
+    if masking:
+        fields.update(MASKING_SCHEMA)
+    if binned:
+        fields["bin_id"] = pa.int64()
+    return pa.schema(list(fields.items()))
+
+
+def rows_to_table(rows, schema):
+    columns = {
+        name: [r.get(name) for r in rows] for name in schema.names
+    }
+    return pa.table(columns, schema=schema)
+
+
+def write_shard(rows, out_dir, part_id, masking=False, bin_size=None,
+                target_seq_length=128, compression="snappy"):
+    """Write one block's rows as part.<part_id>.parquet[_<bin>] files.
+
+    Returns {written_path: num_rows}. With binning enabled, only non-empty
+    bins produce a file (ref: binning.py:353-431); the balancer later
+    equalizes the global per-bin file sets.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    written = {}
+    if bin_size is None:
+        schema = make_schema(masking=masking, binned=False)
+        path = os.path.join(out_dir, "part.{}.parquet".format(part_id))
+        pq.write_table(rows_to_table(rows, schema), path,
+                       compression=compression)
+        written[path] = len(rows)
+        return written
+
+    nbins = num_bins(target_seq_length, bin_size)
+    schema = make_schema(masking=masking, binned=True)
+    by_bin = {}
+    for r in rows:
+        b = bin_id_of_num_tokens(r["num_tokens"], bin_size, nbins)
+        r = dict(r)
+        r["bin_id"] = b
+        by_bin.setdefault(b, []).append(r)
+    for b, bin_rows in sorted(by_bin.items()):
+        path = os.path.join(out_dir, "part.{}.parquet_{}".format(part_id, b))
+        pq.write_table(rows_to_table(bin_rows, schema), path,
+                       compression=compression)
+        written[path] = len(bin_rows)
+    return written

@@ -1,0 +1,129 @@
+"""Input discovery and block planning for the preprocessors.
+
+Reference parity: lddl/dask/readers.py. The reference builds a dask.bag with
+``db.read_text(blocksize=total_bytes/num_blocks)``; our scheduling is static
+and deterministic instead (SURVEY.md §7.4): the input corpus is planned into
+an explicit list of byte-range Blocks once, identically on every host, and
+hosts/workers pick blocks by striding — no task scheduler process needed.
+
+Input contract (downloader output): text files where each line is one
+document and the first whitespace-separated token is the document id
+(ref: lddl/dask/readers.py:131-136).
+"""
+
+import dataclasses
+import os
+
+from ..utils.fs import get_all_files_paths_under
+from ..utils import rng as lrng
+
+
+@dataclasses.dataclass(frozen=True)
+class Block:
+    """A whole-line-aligned byte range of one input text file."""
+    block_id: int
+    path: str
+    start: int
+    end: int  # exclusive
+
+
+def _find_text_files_under(root):
+    return [
+        p for p in get_all_files_paths_under(root)
+        if os.path.basename(p).endswith(".txt")
+    ]
+
+
+def discover_source_files(corpus_paths):
+    """Flatten {corpus_name: path} into a sorted list of input text files.
+
+    Each corpus path may point either at the corpus root (containing
+    ``source/``) or directly at a directory of ``.txt`` shards.
+    """
+    files = []
+    for _, path in sorted(corpus_paths.items()):
+        if path is None:
+            continue
+        source = os.path.join(path, "source")
+        root = source if os.path.isdir(source) else path
+        found = _find_text_files_under(root)
+        if not found:
+            raise ValueError("no .txt source shards under {}".format(root))
+        files.extend(found)
+    if not files:
+        raise ValueError("no input corpora given")
+    return files
+
+
+def plan_blocks(input_files, target_num_blocks):
+    """Deterministically split files into ~equal byte-range blocks.
+
+    The block boundaries are provisional byte offsets; readers snap them to
+    line boundaries (a block owns every line that *starts* inside it), so
+    planning needs only file sizes — identical on every host.
+    """
+    sizes = [os.path.getsize(p) for p in input_files]
+    total = sum(sizes)
+    if total == 0:
+        raise ValueError("input corpus is empty")
+    target_num_blocks = max(1, int(target_num_blocks))
+    block_size = max(1, total // target_num_blocks)
+    blocks = []
+    for path, size in zip(input_files, sizes):
+        if size == 0:
+            continue
+        n = max(1, round(size / block_size))
+        for i in range(n):
+            start = size * i // n
+            end = size * (i + 1) // n
+            blocks.append(Block(len(blocks), path, start, end))
+    return blocks
+
+
+def read_block_lines(block):
+    """Yield the lines that start inside ``block`` (whole lines, no \\n).
+
+    Boundary rule: a line belongs to the block containing its first byte.
+    A block whose start is mid-line skips forward to the next line start.
+    """
+    with open(block.path, "rb") as f:
+        if block.start == 0:
+            f.seek(0)
+        else:
+            f.seek(block.start - 1)
+            # If the previous byte is not a newline, our start is mid-line:
+            # that line belongs to the previous block.
+            prev = f.read(1)
+            if prev != b"\n":
+                f.readline()
+        while f.tell() < block.end:
+            line = f.readline()
+            if not line:
+                break
+            yield line.decode("utf-8", errors="replace").rstrip("\n")
+
+
+def split_id_text(raw_line):
+    """'<doc id> <text...>' -> (doc_id, text). (ref: readers.py:131-136)"""
+    parts = raw_line.split(None, 1)
+    if len(parts) == 0:
+        return None, ""
+    if len(parts) == 1:
+        return parts[0], ""
+    return parts[0], parts[1]
+
+
+def read_documents(block, sample_ratio=1.0, base_seed=12345):
+    """Yield (doc_id, text) for non-empty documents of a block, keeping each
+    with probability ``sample_ratio`` (seeded per block, ref:
+    readers.py:60-71 random_sample)."""
+    g = lrng.sample_rng(base_seed, block.block_id) if sample_ratio < 1.0 else None
+    for line in read_block_lines(block):
+        if not line.strip():
+            continue
+        if g is not None and g.random() >= sample_ratio:
+            continue
+        doc_id, text = split_id_text(line)
+        if not text.strip():
+            continue
+        yield doc_id, text

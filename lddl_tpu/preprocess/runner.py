@@ -1,0 +1,228 @@
+"""Distributed preprocessing runner.
+
+Reference parity: the execution layer that lddl delegates to Dask + dask-mpi
+(lddl/dask/bert/pretrain.py:573-581) plus the global shuffle it performs as
+a dask bag->dataframe all-to-all (pretrain.py:100-111).
+
+TPU-native redesign (SURVEY.md §7.4): the task graph of this workload is
+embarrassingly parallel per block, so we replace the dynamic scheduler with
+*static deterministic scheduling*: every host plans the identical block
+list, takes blocks by rank striding, and synchronizes only at phase
+barriers via the Communicator (jax.distributed on pods; no MPI). The global
+document shuffle is a two-pass, shared-filesystem all-to-all:
+
+    phase 1 (scatter):  each worker reads its input blocks and appends every
+                        document to a hash-assigned bucket spool file
+                        (_shuffle/bucket-<k>/block-<b>.txt) — the bucket is a
+                        deterministic hash of (seed, doc position), so the
+                        assignment is a true random permutation independent
+                        of input order.
+    phase 2 (gather):   each worker owns buckets by striding, reads a
+                        bucket's spool files, shuffles in-bucket, tokenizes,
+                        builds pairs, and writes part.<k>.parquet[_<bin>].
+
+TPU pods always mount shared storage (GCS/NFS) for their shards, so the
+spool rides the same medium the output does.
+"""
+
+import hashlib
+import os
+import shutil
+import time
+
+from ..parallel.distributed import LocalCommunicator
+from ..utils import rng as lrng
+from .bert import BertPretrainConfig, documents_from_texts, pairs_from_documents
+from .readers import discover_source_files, plan_blocks, read_documents
+from . import binning as binning_mod
+
+_SPOOL_DIR = "_shuffle"
+
+
+def _bucket_of(seed, block_id, doc_ordinal, nbuckets):
+    digest = hashlib.blake2b(
+        "{}:{}:{}".format(seed, block_id, doc_ordinal).encode(),
+        digest_size=8).digest()
+    return int.from_bytes(digest, "little") % nbuckets
+
+
+def vocab_words_of(tokenizer):
+    """Vocab tokens ordered by id, specials excluded — the population for
+    random-replacement masking. Ordering by id keeps masking deterministic
+    across hosts/python versions."""
+    specials = set(tokenizer.all_special_tokens)
+    vocab = tokenizer.get_vocab()
+    return [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])
+            if t not in specials]
+
+
+def _scatter_phase(blocks, out_dir, comm, sample_ratio, seed, nbuckets, log):
+    """Phase 1: read my blocks, spool each doc to its hash bucket."""
+    spool_root = os.path.join(out_dir, _SPOOL_DIR)
+    for block in blocks[comm.rank::comm.world_size]:
+        sinks = {}
+        try:
+            for ordinal, (doc_id, text) in enumerate(
+                    read_documents(block, sample_ratio=sample_ratio,
+                                   base_seed=seed)):
+                b = _bucket_of(seed, block.block_id, ordinal, nbuckets)
+                sink = sinks.get(b)
+                if sink is None:
+                    bucket_dir = os.path.join(spool_root, "bucket-{}".format(b))
+                    os.makedirs(bucket_dir, exist_ok=True)
+                    sink = open(
+                        os.path.join(bucket_dir,
+                                     "block-{}.txt".format(block.block_id)),
+                        "w", encoding="utf-8")
+                    sinks[b] = sink
+                sink.write(doc_id + " " + text + "\n")
+        finally:
+            for sink in sinks.values():
+                sink.close()
+    log("rank {}: scatter phase done".format(comm.rank))
+
+
+def _read_bucket_docs(out_dir, bucket):
+    bucket_dir = os.path.join(out_dir, _SPOOL_DIR, "bucket-{}".format(bucket))
+    texts = []
+    if not os.path.isdir(bucket_dir):
+        return texts
+    for name in sorted(os.listdir(bucket_dir)):
+        with open(os.path.join(bucket_dir, name), encoding="utf-8") as f:
+            for line in f:
+                line = line.rstrip("\n")
+                if line.strip():
+                    # Strip the doc id; pair creation is id-agnostic.
+                    parts = line.split(None, 1)
+                    if len(parts) == 2 and parts[1].strip():
+                        texts.append(parts[1])
+    return texts
+
+
+def _process_bucket(texts, bucket, tokenizer, config, vocab_words, seed,
+                    out_dir, bin_size, output_format):
+    g = lrng.sample_rng(seed, 0x9A1A, bucket)
+    lrng.shuffle(g, texts)
+    documents = documents_from_texts(texts, tokenizer)
+    rows = pairs_from_documents(documents, config, g, vocab_words=vocab_words)
+    if output_format == "txt":
+        return _write_txt_shard(rows, out_dir, bucket, config.masking,
+                                bin_size, config.max_seq_length)
+    return binning_mod.write_shard(
+        rows, out_dir, bucket, masking=config.masking, bin_size=bin_size,
+        target_seq_length=config.max_seq_length)
+
+
+def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
+                     target_seq_length):
+    """Human-readable debug sink (ref: pretrain.py:501-532 _save_txt)."""
+    from ..utils.fs import deserialize_np_array
+    os.makedirs(out_dir, exist_ok=True)
+
+    def fmt(r):
+        if masking:
+            return ("is_random_next: {} - [CLS] {} [SEP] {} [SEP] - "
+                    "masked_lm_positions: {} - masked_lm_labels: {} - {}".format(
+                        r["is_random_next"], r["A"], r["B"],
+                        deserialize_np_array(r["masked_lm_positions"]).tolist(),
+                        r["masked_lm_labels"], r["num_tokens"]))
+        return "is_random_next: {} - [CLS] {} [SEP] {} [SEP] - {}".format(
+            r["is_random_next"], r["A"], r["B"], r["num_tokens"])
+
+    written = {}
+    if bin_size is None:
+        path = os.path.join(out_dir, "{}.txt".format(part_id))
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(fmt(r) + "\n")
+        written[path] = len(rows)
+        return written
+    nbins = binning_mod.num_bins(target_seq_length, bin_size)
+    by_bin = {}
+    for r in rows:
+        b = binning_mod.bin_id_of_num_tokens(r["num_tokens"], bin_size, nbins)
+        by_bin.setdefault(b, []).append(r)
+    for b, bin_rows in sorted(by_bin.items()):
+        path = os.path.join(out_dir, "{}.txt_{}".format(part_id, b))
+        with open(path, "w") as f:
+            for r in bin_rows:
+                f.write(fmt(r) + "\n")
+        written[path] = len(bin_rows)
+    return written
+
+
+def run_bert_preprocess(
+    corpus_paths,
+    out_dir,
+    tokenizer,
+    config=None,
+    num_blocks=64,
+    sample_ratio=0.9,
+    seed=12345,
+    bin_size=None,
+    global_shuffle=True,
+    output_format="parquet",
+    comm=None,
+    log=None,
+):
+    """Run the full BERT preprocessing pipeline; returns {path: num_rows}.
+
+    SPMD: call on every host with the same arguments; hosts split the work
+    by ``comm`` rank and meet at barriers.
+    """
+    config = config or BertPretrainConfig()
+    comm = comm or LocalCommunicator()
+    log = log or (lambda msg: None)
+    if output_format not in ("parquet", "txt"):
+        raise ValueError("output_format must be parquet|txt")
+    if bin_size is not None:
+        binning_mod.num_bins(config.max_seq_length, bin_size)  # validate
+
+    # Refuse a dirty output dir: stale part files from a previous run with a
+    # different block count would silently survive next to fresh ones and
+    # duplicate data downstream.
+    if os.path.isdir(out_dir):
+        stale = [
+            n for n in os.listdir(out_dir)
+            if ".parquet" in n or (".txt" in n and not n.startswith("."))
+            or n == _SPOOL_DIR
+        ]
+        if stale:
+            raise ValueError(
+                "output dir {} already contains {} shard files (e.g. {}); "
+                "remove them or choose a fresh directory".format(
+                    out_dir, len(stale), stale[0]))
+    # No rank may start writing before every rank has passed the guard.
+    comm.barrier()
+
+    t0 = time.time()
+    input_files = discover_source_files(corpus_paths)
+    blocks = plan_blocks(input_files, num_blocks)
+    nbuckets = len(blocks)
+    log("{} input files -> {} blocks".format(len(input_files), len(blocks)))
+
+    vocab_words = vocab_words_of(tokenizer) if config.masking else None
+
+    if global_shuffle:
+        _scatter_phase(blocks, out_dir, comm, sample_ratio, seed, nbuckets, log)
+        comm.barrier()
+
+    written = {}
+    for bucket in range(comm.rank, nbuckets, comm.world_size):
+        if global_shuffle:
+            texts = _read_bucket_docs(out_dir, bucket)
+        else:
+            texts = [
+                text for _, text in read_documents(
+                    blocks[bucket], sample_ratio=sample_ratio, base_seed=seed)
+            ]
+        written.update(
+            _process_bucket(texts, bucket, tokenizer, config, vocab_words,
+                            seed, out_dir, bin_size, output_format))
+    comm.barrier()
+
+    if global_shuffle and comm.rank == 0:
+        shutil.rmtree(os.path.join(out_dir, _SPOOL_DIR), ignore_errors=True)
+    log("preprocess done in {:.1f}s, {} shards, {} samples".format(
+        time.time() - t0, len(written), sum(written.values())))
+    return written

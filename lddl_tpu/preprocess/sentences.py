@@ -1,0 +1,86 @@
+"""Sentence segmentation.
+
+Reference parity: the reference calls ``nltk.tokenize.sent_tokenize``
+(lddl/dask/bert/pretrain.py:82, lddl/dask/bart/pretrain.py:82), which needs
+the punkt model downloaded at image-build time. TPU pods are often
+egress-restricted, so we ship a self-contained rule-based splitter and use
+punkt only when its data is actually present on disk.
+
+The rule-based splitter targets the same corpora (Wikipedia / books / news):
+split on [.!?] + closing quotes/brackets followed by whitespace and an
+uppercase/digit/quote start, with guards for common abbreviations, initials,
+decimal numbers, and ellipses.
+"""
+
+import re
+
+_ABBREVIATIONS = frozenset(
+    s.lower() for s in (
+        "Mr Mrs Ms Dr Prof Sr Jr St Lt Col Gen Rep Sen Gov Capt Cmdr Sgt "
+        "Rev Hon Pres Supt Det Insp "
+        "vs etc al eg ie cf ca approx "
+        "Jan Feb Mar Apr Jun Jul Aug Sep Sept Oct Nov Dec "
+        "Mon Tue Wed Thu Fri Sat Sun "
+        "No Vol Fig Eq Sec Ch pp ed eds trans "
+        "Inc Ltd Corp Co Dept Univ Assn Bros "
+        "a.m p.m U.S U.K U.N E.U Ph.D M.D B.A M.A D.C").split())
+
+# A sentence boundary: terminator + optional closing quotes/brackets
+# (group 1), whitespace, then a plausible sentence start.
+_BOUNDARY = re.compile(
+    r"([.!?][\"'\)\]”’]*)\s+(?=[\"'\(\[“‘]?[A-Z0-9])")
+
+
+def _use_nltk():
+    try:
+        import nltk.data
+        nltk.data.find("tokenizers/punkt")
+        return True
+    except Exception:
+        return False
+
+
+_NLTK_AVAILABLE = None
+
+
+def _looks_like_abbreviation(left):
+    """Is the text left of the boundary an abbreviation / initial / number
+    that should NOT end a sentence?"""
+    m = re.search(r"(\S+)$", left)
+    if not m:
+        return False
+    word = m.group(1)
+    core = word.rstrip(".").strip("\"'()[]“”‘’")
+    if not core:
+        return False
+    # Single capital letter ("J. Smith") or dotted initials ("U.S.").
+    if len(core) == 1 and core.isalpha():
+        return True
+    if re.fullmatch(r"(?:[A-Za-z]\.)+[A-Za-z]?", core):
+        return True
+    return core.lower() in _ABBREVIATIONS
+
+
+def split_sentences(text):
+    """Split ``text`` into sentences (non-empty, stripped)."""
+    global _NLTK_AVAILABLE
+    if _NLTK_AVAILABLE is None:
+        _NLTK_AVAILABLE = _use_nltk()
+    if _NLTK_AVAILABLE:
+        from nltk.tokenize import sent_tokenize
+        return [s.strip() for s in sent_tokenize(text) if s.strip()]
+
+    sentences = []
+    start = 0
+    for m in _BOUNDARY.finditer(text):
+        # Left context up to and including the terminator character.
+        if _looks_like_abbreviation(text[start:m.start(1) + 1]):
+            continue
+        piece = text[start:m.end(1)].strip()
+        if piece:
+            sentences.append(piece)
+        start = m.end()
+    tail = text[start:].strip()
+    if tail:
+        sentences.append(tail)
+    return sentences

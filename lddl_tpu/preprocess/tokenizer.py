@@ -1,0 +1,75 @@
+"""Tokenizer provisioning.
+
+Reference parity: lddl/dask/bert/pretrain.py:584-587 (BertTokenizerFast from
+a local vocab file or the HF hub). We add ``build_wordpiece_vocab`` so fully
+offline environments (TPU pods commonly have no egress) can bootstrap a
+working WordPiece vocab directly from a corpus sample.
+"""
+
+import collections
+import os
+
+
+def get_tokenizer(vocab_file=None, pretrained_model_name=None,
+                  do_lower_case=True):
+    """A HF fast WordPiece tokenizer from a vocab file or hub name."""
+    from transformers import BertTokenizerFast
+    if vocab_file is not None:
+        if not os.path.isfile(vocab_file):
+            raise FileNotFoundError("vocab file not found: {}".format(vocab_file))
+        return BertTokenizerFast(vocab_file, do_lower_case=do_lower_case)
+    if pretrained_model_name is not None:
+        return BertTokenizerFast.from_pretrained(
+            pretrained_model_name, do_lower_case=do_lower_case)
+    raise ValueError("need vocab_file or pretrained_model_name")
+
+
+SPECIAL_TOKENS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")
+
+
+def build_wordpiece_vocab(texts, out_path, vocab_size=30000,
+                          do_lower_case=True, min_frequency=1):
+    """Train a WordPiece vocab from an iterable of texts; write one token
+    per line (BERT vocab format). Returns the path.
+
+    Uses the HF ``tokenizers`` WordPiece trainer when available; falls back
+    to specials + bytes-as-chars + frequent whole words, which is enough for
+    tests and smoke runs.
+    """
+    texts = list(texts)
+    try:
+        from tokenizers import Tokenizer, models, trainers, normalizers, pre_tokenizers
+        tok = Tokenizer(models.WordPiece(unk_token="[UNK]"))
+        norms = [normalizers.NFD(), normalizers.StripAccents()]
+        if do_lower_case:
+            norms.insert(0, normalizers.Lowercase())
+        tok.normalizer = normalizers.Sequence(norms)
+        tok.pre_tokenizer = pre_tokenizers.BertPreTokenizer()
+        trainer = trainers.WordPieceTrainer(
+            vocab_size=vocab_size,
+            min_frequency=min_frequency,
+            special_tokens=list(SPECIAL_TOKENS),
+            continuing_subword_prefix="##",
+        )
+        tok.train_from_iterator(texts, trainer)
+        vocab = sorted(tok.get_vocab().items(), key=lambda kv: kv[1])
+        tokens = [t for t, _ in vocab]
+    except ImportError:
+        counter = collections.Counter()
+        chars = set()
+        for t in texts:
+            if do_lower_case:
+                t = t.lower()
+            for w in t.split():
+                w = w.strip(".,;:!?\"'()[]")
+                if w:
+                    counter[w] += 1
+                    chars.update(w)
+        tokens = list(SPECIAL_TOKENS)
+        tokens.extend(sorted(chars))
+        tokens.extend(
+            w for w, c in counter.most_common(vocab_size) if c >= min_frequency)
+    with open(out_path, "w") as f:
+        for t in tokens:
+            f.write(t + "\n")
+    return out_path

@@ -1,0 +1,145 @@
+"""Balancer: ±1 invariant, content preservation, per-bin balancing, SPMD."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from lddl_tpu.balance import balance_shards, generate_num_samples_cache
+from lddl_tpu.parallel import ThreadGroupCommunicator
+from lddl_tpu.utils.fs import (
+    get_all_parquets_under,
+    get_num_samples_of_parquet,
+    read_num_samples_cache,
+)
+
+
+def _write_unbalanced(dir_path, sizes, bin_id=None, tag=0):
+    os.makedirs(dir_path, exist_ok=True)
+    postfix = "" if bin_id is None else "_{}".format(bin_id)
+    rows = 0
+    for i, n in enumerate(sizes):
+        uid = ["{}-{}-{}".format(tag, i, j) for j in range(n)]
+        t = pa.table({
+            "A": uid,
+            "B": ["b"] * n,
+            "is_random_next": [False] * n,
+            "num_tokens": pa.array([5] * n, type=pa.uint16()),
+        })
+        pq.write_table(
+            t, os.path.join(dir_path, "part.{}.parquet{}".format(i, postfix)))
+        rows += n
+    return rows
+
+
+def _collect_ids(paths):
+    ids = []
+    for p in paths:
+        ids.extend(pq.read_table(p).column("A").to_pylist())
+    return ids
+
+
+def test_balance_basic(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    total = _write_unbalanced(src, [50, 3, 17, 0, 30])
+    counts = balance_shards(src, dst, num_shards=4)
+    assert sum(counts.values()) == total
+    vals = sorted(counts.values())
+    assert vals[-1] - vals[0] <= 1
+    # Content preserved exactly (no loss, no duplication).
+    src_ids = _collect_ids(get_all_parquets_under(src))
+    dst_ids = _collect_ids(get_all_parquets_under(dst))
+    assert sorted(src_ids) == sorted(dst_ids)
+    # Cache written and accurate.
+    cache = read_num_samples_cache(dst)
+    assert cache == counts
+    for name, n in counts.items():
+        assert get_num_samples_of_parquet(os.path.join(dst, name)) == n
+
+
+def test_balance_already_balanced(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    _write_unbalanced(src, [10, 10, 10])
+    counts = balance_shards(src, dst, num_shards=3)
+    assert sorted(counts.values()) == [10, 10, 10]
+
+
+def test_balance_binned(tmp_path):
+    src = str(tmp_path / "src")
+    dst = str(tmp_path / "dst")
+    t0 = _write_unbalanced(src, [40, 2], bin_id=0, tag=0)
+    t1 = _write_unbalanced(src, [7, 31, 1], bin_id=1, tag=1)
+    counts = balance_shards(src, dst, num_shards=2)
+    bin0 = {k: v for k, v in counts.items() if k.endswith("_0")}
+    bin1 = {k: v for k, v in counts.items() if k.endswith("_1")}
+    assert sum(bin0.values()) == t0 and sum(bin1.values()) == t1
+    for group in (bin0, bin1):
+        vals = sorted(group.values())
+        assert vals[-1] - vals[0] <= 1
+
+
+@pytest.mark.parametrize("world", [2, 4])
+def test_balance_spmd_matches_single(tmp_path, world):
+    sizes = [23, 1, 64, 9, 0, 41, 13]
+    src = str(tmp_path / "src")
+    total = _write_unbalanced(src, sizes)
+
+    dst1 = str(tmp_path / "dst1")
+    counts1 = balance_shards(src, dst1, num_shards=4)
+
+    dstN = str(tmp_path / "dstN")
+    results = ThreadGroupCommunicator.spawn(
+        world, lambda comm: balance_shards(src, dstN, 4, comm=comm))
+    for counts in results:
+        assert counts == counts1
+    assert sum(counts1.values()) == total
+    # Same rows overall, and per-shard counts match the single-rank run.
+    assert sorted(_collect_ids(get_all_parquets_under(dstN))) == \
+        sorted(_collect_ids(get_all_parquets_under(dst1)))
+    for name, n in counts1.items():
+        assert get_num_samples_of_parquet(os.path.join(dstN, name)) == n
+
+
+def test_generate_num_samples_cache(tmp_path):
+    src = str(tmp_path / "src")
+    _write_unbalanced(src, [5, 8])
+    counts = generate_num_samples_cache(src)
+    assert counts == {"part.0.parquet": 5, "part.1.parquet": 8}
+    assert read_num_samples_cache(src) == counts
+
+
+def test_balance_validates_input(tmp_path):
+    with pytest.raises(ValueError):
+        balance_shards(str(tmp_path / "empty"), str(tmp_path / "o"), 2)
+    src = str(tmp_path / "src")
+    _write_unbalanced(src, [5])
+    with pytest.raises(ValueError):
+        balance_shards(src, str(tmp_path / "o"), 0)
+    # More shards than samples is a user error, not silent zero-shards.
+    with pytest.raises(ValueError, match="at least one sample"):
+        balance_shards(src, str(tmp_path / "o2"), 9)
+    # Dirty output dir refused.
+    dst = str(tmp_path / "dst")
+    balance_shards(src, dst, 2)
+    with pytest.raises(ValueError, match="already contains"):
+        balance_shards(src, dst, 2)
+
+
+def test_balance_drained_output_file_removed(tmp_path):
+    """A shard forced to give away rows it had staged to disk must not
+    leave a stale shard file behind."""
+    src = str(tmp_path / "src")
+    # Heavy skew: shard 1 (file part.1) starts huge, must both receive
+    # custody (leftover writes) and later drain in multi-iteration runs.
+    _write_unbalanced(src, [1, 60, 1, 2])
+    dst = str(tmp_path / "dst")
+    counts = balance_shards(src, dst, num_shards=4)
+    on_disk = sorted(os.listdir(dst))
+    expected = sorted(list(counts.keys()) + [".num_samples.json"])
+    assert on_disk == expected
+    for name, n in counts.items():
+        assert get_num_samples_of_parquet(os.path.join(dst, name)) == n

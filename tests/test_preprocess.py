@@ -1,0 +1,239 @@
+"""Preprocessor: sentence split, pair creation, masking, binning, e2e run."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lddl_tpu.preprocess import (
+    BertPretrainConfig,
+    build_wordpiece_vocab,
+    create_masked_lm_predictions,
+    create_pairs_from_document,
+    get_tokenizer,
+    num_bins,
+    bin_id_of_num_tokens,
+    run_bert_preprocess,
+    split_sentences,
+)
+from lddl_tpu.preprocess.bert import documents_from_texts, pairs_from_documents
+from lddl_tpu.preprocess.readers import plan_blocks, read_block_lines
+from lddl_tpu.preprocess.runner import vocab_words_of
+from lddl_tpu.utils import rng as lrng
+from lddl_tpu.utils.fs import (
+    deserialize_np_array,
+    get_all_parquets_under,
+    get_all_bin_ids,
+    get_num_samples_of_parquet,
+)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+             "lambda mu nu xi omicron pi rho sigma tau upsilon").split()
+    texts = [" ".join(words)] * 4
+    path = tmp_path_factory.mktemp("vocab") / "vocab.txt"
+    return build_wordpiece_vocab(texts, str(path), vocab_size=200)
+
+
+@pytest.fixture(scope="module")
+def tokenizer(vocab_file):
+    return get_tokenizer(vocab_file=vocab_file)
+
+
+def test_split_sentences_basic():
+    s = split_sentences("Hello world. This is fine! Is it? Yes.")
+    assert s == ["Hello world.", "This is fine!", "Is it?", "Yes."]
+
+
+def test_split_sentences_abbreviations():
+    s = split_sentences("Dr. Smith went to Washington. He arrived at 3 p.m. "
+                        "It was raining.")
+    assert "Dr. Smith went to Washington." in s
+    # 'p.m.' boundary followed by uppercase is ambiguous; we only require
+    # that the abbreviation itself never produces a 1-word fragment "Dr."
+    assert all(len(x) > 4 for x in s)
+
+
+def test_split_sentences_initials_and_decimals():
+    s = split_sentences("J. R. Tolkien wrote it. The value is 3.14 exactly. Done.")
+    assert s[0].startswith("J. R. Tolkien")
+    assert any("3.14" in x for x in s)
+
+
+def test_plan_blocks_and_read(tiny_corpus):
+    from lddl_tpu.preprocess.readers import discover_source_files
+    files = discover_source_files({"wikipedia": tiny_corpus})
+    assert len(files) == 4
+    blocks = plan_blocks(files, 8)
+    # Every line appears exactly once across blocks.
+    all_lines = []
+    for b in blocks:
+        all_lines.extend(read_block_lines(b))
+    expected = []
+    for p in files:
+        with open(p) as f:
+            expected.extend(l.rstrip("\n") for l in f)
+    assert sorted(all_lines) == sorted(expected)
+
+
+def test_documents_from_texts(tokenizer):
+    docs = documents_from_texts(
+        ["Alpha beta gamma. Delta epsilon zeta.", "", "Eta theta."],
+        tokenizer)
+    assert len(docs) == 2
+    assert len(docs[0]) == 2  # two sentences
+    assert all(isinstance(t, str) for t in docs[0][0])
+
+
+def test_pair_creation_invariants(tokenizer):
+    texts = [
+        "Alpha beta gamma delta. Epsilon zeta eta theta. Iota kappa lambda mu. "
+        "Nu xi omicron pi. Rho sigma tau upsilon.",
+        "Beta alpha delta gamma. Zeta epsilon theta eta. Kappa iota mu lambda.",
+        "Gamma delta alpha beta. Eta zeta theta epsilon.",
+    ] * 3
+    documents = documents_from_texts(texts, tokenizer)
+    config = BertPretrainConfig(max_seq_length=32, duplicate_factor=2)
+    g = lrng.sample_rng(0, 1)
+    rows = pairs_from_documents(documents, config, g)
+    assert len(rows) > 0
+    saw_random, saw_next = False, False
+    for r in rows:
+        a = r["A"].split()
+        b = r["B"].split()
+        assert 1 <= len(a) and 1 <= len(b)
+        assert len(a) + len(b) <= config.max_seq_length - 3
+        assert r["num_tokens"] == len(a) + len(b) + 3
+        saw_random |= r["is_random_next"]
+        saw_next |= not r["is_random_next"]
+    assert saw_random and saw_next
+
+
+def test_pair_creation_deterministic(tokenizer):
+    texts = ["Alpha beta gamma delta. Epsilon zeta eta theta. Iota kappa."] * 4
+    documents = documents_from_texts(texts, tokenizer)
+    config = BertPretrainConfig(max_seq_length=24)
+    r1 = pairs_from_documents(documents, config, lrng.sample_rng(9, 2))
+    r2 = pairs_from_documents(documents, config, lrng.sample_rng(9, 2))
+    assert r1 == r2
+    r3 = pairs_from_documents(documents, config, lrng.sample_rng(9, 3))
+    assert r1 != r3  # different stream -> different pairs (w.h.p.)
+
+
+def test_masking_stats(tokenizer):
+    vocab_words = vocab_words_of(tokenizer)
+    g = lrng.sample_rng(3, 0)
+    n_masked = 0
+    n_mask_tok = 0
+    n_total = 0
+    for _ in range(200):
+        tokens = ["[CLS]"] + ["alpha"] * 30 + ["[SEP]"] + ["beta"] * 30 + ["[SEP]"]
+        orig = list(tokens)
+        positions, labels = create_masked_lm_predictions(
+            tokens, vocab_words, g, 0.15, 20)
+        assert positions == sorted(positions)
+        assert len(positions) == len(labels)
+        assert len(positions) <= 20
+        for p, lab in zip(positions, labels):
+            assert orig[p] == lab
+            assert tokens[p] != "[CLS]" and tokens[p] != "[SEP]"
+            n_mask_tok += tokens[p] == "[MASK]"
+        # Unmasked positions unchanged.
+        changed = set(positions)
+        for i, (t0, t1) in enumerate(zip(orig, tokens)):
+            if i not in changed:
+                assert t0 == t1
+        n_masked += len(positions)
+        n_total += len(tokens)
+    # ~15% of 63 tokens -> ~9.45/seq; 80% of those become [MASK].
+    assert 0.10 < n_masked / n_total < 0.20
+    assert 0.70 < n_mask_tok / n_masked < 0.90
+
+
+def test_bin_math():
+    assert num_bins(128, 32) == 4
+    with pytest.raises(ValueError):
+        num_bins(128, 24)
+    assert bin_id_of_num_tokens(1, 32, 4) == 0
+    assert bin_id_of_num_tokens(32, 32, 4) == 0
+    assert bin_id_of_num_tokens(33, 32, 4) == 1
+    assert bin_id_of_num_tokens(128, 32, 4) == 3
+    assert bin_id_of_num_tokens(500, 32, 4) == 3  # clamped
+
+
+def test_e2e_preprocess_unbinned(tiny_corpus, tokenizer, tmp_path):
+    out = str(tmp_path / "out")
+    written = run_bert_preprocess(
+        {"wikipedia": tiny_corpus}, out, tokenizer,
+        config=BertPretrainConfig(max_seq_length=32, duplicate_factor=1),
+        num_blocks=4, sample_ratio=1.0, seed=0)
+    paths = get_all_parquets_under(out)
+    assert len(paths) >= 1
+    assert get_all_bin_ids(paths) == []
+    assert sum(written.values()) == sum(
+        get_num_samples_of_parquet(p) for p in paths)
+    assert sum(written.values()) > 10
+
+
+def test_e2e_preprocess_binned_masked(tiny_corpus, tokenizer, tmp_path):
+    out = str(tmp_path / "out")
+    run_bert_preprocess(
+        {"wikipedia": tiny_corpus}, out, tokenizer,
+        config=BertPretrainConfig(max_seq_length=64, duplicate_factor=1,
+                                  masking=True),
+        num_blocks=3, sample_ratio=1.0, seed=0, bin_size=16)
+    paths = get_all_parquets_under(out)
+    bin_ids = get_all_bin_ids(paths)
+    assert len(bin_ids) >= 2  # fixture has varied lengths
+    import pyarrow.parquet as pq
+    t = pq.read_table(paths[0])
+    assert set(t.column_names) == {
+        "A", "B", "is_random_next", "num_tokens",
+        "masked_lm_positions", "masked_lm_labels", "bin_id"}
+    row = t.to_pylist()[0]
+    pos = deserialize_np_array(row["masked_lm_positions"])
+    labels = row["masked_lm_labels"].split()
+    assert len(pos) == len(labels)
+    seq = (["[CLS]"] + row["A"].split() + ["[SEP]"] + row["B"].split()
+           + ["[SEP]"])
+    assert row["num_tokens"] == len(seq)
+    # Bin invariant: num_tokens within the file's bin.
+    b = row["bin_id"]
+    assert b * 16 < row["num_tokens"] <= (b + 1) * 16 or b == 3
+
+
+def test_e2e_multirank_matches_single_rank(tiny_corpus, tokenizer, tmp_path):
+    """Sharded SPMD run produces exactly the same shard set as 1 rank."""
+    from lddl_tpu.parallel import ThreadGroupCommunicator
+    cfg = dict(
+        config=BertPretrainConfig(max_seq_length=32, duplicate_factor=1),
+        num_blocks=4, sample_ratio=1.0, seed=0)
+
+    out1 = str(tmp_path / "single")
+    run_bert_preprocess({"wikipedia": tiny_corpus}, out1, tokenizer, **cfg)
+
+    out4 = str(tmp_path / "four")
+    ThreadGroupCommunicator.spawn(
+        4, lambda comm: run_bert_preprocess(
+            {"wikipedia": tiny_corpus}, out4, tokenizer, comm=comm, **cfg))
+
+    import pyarrow.parquet as pq
+    p1 = get_all_parquets_under(out1)
+    p4 = get_all_parquets_under(out4)
+    assert [os.path.basename(p) for p in p1] == [os.path.basename(p) for p in p4]
+    for a, b in zip(p1, p4):
+        assert pq.read_table(a).equals(pq.read_table(b))
+
+
+def test_txt_output(tiny_corpus, tokenizer, tmp_path):
+    out = str(tmp_path / "out")
+    written = run_bert_preprocess(
+        {"wikipedia": tiny_corpus}, out, tokenizer,
+        config=BertPretrainConfig(max_seq_length=32, duplicate_factor=1),
+        num_blocks=2, sample_ratio=1.0, seed=0, output_format="txt")
+    assert all(p.endswith(".txt") for p in written)
+    line = open(list(written)[0]).readline()
+    assert line.startswith("is_random_next: ")
+    assert "[CLS]" in line and "[SEP]" in line
