@@ -213,6 +213,13 @@ def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None):
     bin_ids = get_all_bin_ids(file_paths)
     counts = {}
     if bin_ids:
+        from ..utils.fs import get_bin_id_of_path
+        unbinned = [p for p in file_paths if get_bin_id_of_path(p) is None]
+        if unbinned:
+            raise ValueError(
+                "input mixes binned and unbinned shards ({} unbinned, e.g. "
+                "{}); balance them separately".format(
+                    len(unbinned), os.path.basename(unbinned[0])))
         for b in bin_ids:
             bin_paths = get_file_paths_for_bin_id(file_paths, b)
             counts.update(

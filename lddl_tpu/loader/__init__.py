@@ -1,0 +1,15 @@
+from .datasets import ShuffleBuffer, ParquetDataset
+from .dataloader import DataLoader, Binned
+from .bert import get_bert_pretrain_data_loader, BertPretrainBinned
+from .sharding import process_dp_info, to_device_batch
+
+__all__ = [
+    "ShuffleBuffer",
+    "ParquetDataset",
+    "DataLoader",
+    "Binned",
+    "get_bert_pretrain_data_loader",
+    "BertPretrainBinned",
+    "process_dp_info",
+    "to_device_batch",
+]

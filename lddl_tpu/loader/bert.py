@@ -1,0 +1,266 @@
+"""BERT pretraining data loader: decode, collation, dynamic masking, factory.
+
+Reference parity: lddl/torch/bert.py (and the torch_mp variant's loss-mask
+output). Differences by design, for TPU:
+
+- Batches are numpy (int32) dicts; the training step moves them to devices
+  as globally-sharded jax.Arrays via loader/sharding.py.
+- ``fixed_seq_lengths`` pads every batch of a bin to that bin's boundary
+  instead of the batch max: a *bounded set of static shapes* means a
+  bounded number of XLA compilations (the TPU version of the reference's
+  Tensor-Core alignment trick, lddl/torch/bert.py:91-96 — which we also
+  keep for the unbinned path via ``sequence_length_alignment``).
+- Dynamic masking is vectorized numpy on deterministic per-(epoch, dp
+  group, worker) streams — identical across TP/PP peers, like everything
+  else in the loader.
+"""
+
+import numpy as np
+
+from ..utils.fs import (
+    deserialize_np_array,
+    get_all_bin_ids,
+    get_all_parquets_under,
+    get_file_paths_for_bin_id,
+)
+from ..utils.logging import DatasetLogger
+from .dataloader import Binned, DataLoader
+from .datasets import ParquetDataset
+
+
+def decode_record_batch(b):
+    """Yield sample tuples from a parquet RecordBatch:
+    (A, B, is_random_next[, masked_lm_positions, masked_lm_labels])."""
+    columns = set(b.schema.names)
+    static = "masked_lm_positions" in columns
+    b = b.to_pydict()
+    if static:
+        for row in zip(b["A"], b["B"], b["is_random_next"],
+                       b["masked_lm_positions"], b["masked_lm_labels"]):
+            yield row
+    else:
+        for row in zip(b["A"], b["B"], b["is_random_next"]):
+            yield row
+
+
+class BertCollate:
+    """samples -> encoded numpy batch dict.
+
+    Static masking (5-tuples): emits ``labels`` from the stored positions.
+    Dynamic masking (3-tuples): masks on the fly with the worker stream.
+    Output keys: input_ids, token_type_ids, attention_mask,
+    next_sentence_labels, labels, masked_lm_positions-mask (``loss_mask``,
+    the torch_mp extra output for Megatron-style loss,
+    ref lddl/torch_mp/bert.py:103-105).
+    """
+
+    needs_rng = True
+
+    def __init__(self, tokenizer, sequence_length_alignment=8,
+                 fixed_seq_length=None, ignore_index=-1, mlm_prob=0.15,
+                 emit_loss_mask=False):
+        self._tokenizer = tokenizer
+        self._align = sequence_length_alignment
+        self._fixed_seq_length = fixed_seq_length
+        self._ignore_index = ignore_index
+        self._mlm_prob = mlm_prob
+        self._emit_loss_mask = emit_loss_mask
+        self._mask_id = tokenizer.convert_tokens_to_ids("[MASK]")
+        self._cls_id = tokenizer.convert_tokens_to_ids("[CLS]")
+        self._sep_id = tokenizer.convert_tokens_to_ids("[SEP]")
+        self._vocab_size = len(tokenizer)
+
+    def _batch_seq_len(self, lens):
+        longest = max(lens)
+        if self._fixed_seq_length is not None:
+            if longest > self._fixed_seq_length:
+                raise ValueError(
+                    "sample of {} tokens exceeds fixed_seq_length {}".format(
+                        longest, self._fixed_seq_length))
+            return self._fixed_seq_length
+        return ((longest - 1) // self._align + 1) * self._align
+
+    def __call__(self, samples, g=None):
+        n = len(samples)
+        static = len(samples[0]) == 5
+        tok = self._tokenizer
+        a_ids = [tok.convert_tokens_to_ids(s[0].split()) for s in samples]
+        b_ids = [tok.convert_tokens_to_ids(s[1].split()) for s in samples]
+        seq_len = self._batch_seq_len(
+            [len(a) + len(b) + 3 for a, b in zip(a_ids, b_ids)])
+
+        input_ids = np.zeros((n, seq_len), dtype=np.int32)
+        token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
+        attention_mask = np.zeros((n, seq_len), dtype=np.int32)
+        special_tokens_mask = np.ones((n, seq_len), dtype=bool)
+        labels = np.full((n, seq_len), self._ignore_index, dtype=np.int32)
+
+        for i, (a, b) in enumerate(zip(a_ids, b_ids)):
+            la, lb = len(a), len(b)
+            end = la + lb + 3
+            input_ids[i, 0] = self._cls_id
+            input_ids[i, 1:1 + la] = a
+            input_ids[i, 1 + la] = self._sep_id
+            input_ids[i, 2 + la:2 + la + lb] = b
+            input_ids[i, end - 1] = self._sep_id
+            token_type_ids[i, 2 + la:end] = 1
+            attention_mask[i, :end] = 1
+            # Non-special positions eligible for masking.
+            special_tokens_mask[i, 1:1 + la] = False
+            special_tokens_mask[i, 2 + la:end - 1] = False
+            if static:
+                positions = deserialize_np_array(samples[i][3]).astype(np.int64)
+                label_ids = tok.convert_tokens_to_ids(samples[i][4].split())
+                labels[i, positions] = np.asarray(label_ids, dtype=np.int32)
+
+        if not static:
+            if g is None:
+                raise ValueError("dynamic masking needs a worker RNG")
+            input_ids, labels = self._mask_tokens(
+                input_ids, special_tokens_mask, g)
+
+        batch = {
+            "input_ids": input_ids,
+            "token_type_ids": token_type_ids,
+            "attention_mask": attention_mask,
+            "next_sentence_labels": np.asarray(
+                [int(s[2]) for s in samples], dtype=np.int32),
+            "labels": labels,
+        }
+        if self._emit_loss_mask:
+            batch["loss_mask"] = (labels != self._ignore_index).astype(np.int32)
+        return batch
+
+    def _mask_tokens(self, input_ids, special_tokens_mask, g):
+        """Vectorized HF-style dynamic masking: select ~mlm_prob of
+        non-special tokens; of those 80% -> [MASK], 10% -> random token,
+        10% -> unchanged. (ref: lddl/torch/bert.py:152-196)"""
+        shape = input_ids.shape
+        masked = (g.random(shape) < self._mlm_prob) & ~special_tokens_mask
+        labels = np.where(masked, input_ids, self._ignore_index).astype(np.int32)
+        r = g.random(shape)
+        out = input_ids.copy()
+        out[masked & (r < 0.8)] = self._mask_id
+        random_sel = masked & (r >= 0.8) & (r < 0.9)
+        random_words = g.integers(0, self._vocab_size, shape, dtype=np.int32)
+        out[random_sel] = random_words[random_sel]
+        return out, labels
+
+
+class BertPretrainBinned(Binned):
+
+    def _get_batch_size(self, batch):
+        return len(batch["input_ids"])
+
+
+def get_bert_pretrain_data_loader(
+    path,
+    dp_rank=0,
+    num_dp_groups=1,
+    batch_size=64,
+    num_workers=1,
+    shuffle_buffer_size=16384,
+    shuffle_buffer_warmup_factor=16,
+    tokenizer=None,
+    vocab_file=None,
+    tokenizer_name=None,
+    sequence_length_alignment=8,
+    fixed_seq_lengths=None,
+    ignore_index=-1,
+    mlm_prob=0.15,
+    emit_loss_mask=False,
+    base_seed=12345,
+    start_epoch=0,
+    log_dir=None,
+    log_level=None,
+    return_raw_samples=False,
+    prefetch=2,
+    comm=None,
+):
+    """Build the BERT pretraining loader over balanced shards at ``path``.
+
+    Auto-detects binned vs unbinned from the shard filenames and static vs
+    dynamic masking from the parquet schema
+    (ref: lddl/torch/bert.py:199-413). For TPU static shapes pass
+    ``fixed_seq_lengths``: an int (unbinned) or a list with one padded
+    length per bin.
+
+    ``dp_rank``/``num_dp_groups`` name the data-parallel group of this
+    process — derive them from a device mesh with
+    ``lddl_tpu.loader.process_dp_info(mesh)``. All processes in the same
+    group receive identical batches (ref: lddl/torch_mp/bert.py:203-211).
+    """
+    import logging
+    if tokenizer is None:
+        from ..preprocess.tokenizer import get_tokenizer
+        tokenizer = get_tokenizer(vocab_file=vocab_file,
+                                  pretrained_model_name=tokenizer_name)
+    logger = DatasetLogger(
+        log_dir=log_dir,
+        log_level=log_level if log_level is not None else logging.WARNING,
+        rank=dp_rank,
+    )
+    file_paths = get_all_parquets_under(path)
+    if not file_paths:
+        raise ValueError("no parquet shards under {}".format(path))
+    bin_ids = get_all_bin_ids(file_paths)
+
+    def make_dataset(paths, transform=None):
+        return ParquetDataset(
+            paths,
+            base_seed=base_seed,
+            start_epoch=start_epoch,
+            dp_rank=dp_rank,
+            num_dp_groups=num_dp_groups,
+            num_workers=num_workers,
+            shuffle_buffer_size=shuffle_buffer_size,
+            shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+            decode_record_batch=decode_record_batch,
+            transform=transform,
+            comm=comm,
+            logger=logger,
+        )
+
+    def make_collate(fixed_seq_length):
+        if return_raw_samples:
+            return None
+        return BertCollate(
+            tokenizer,
+            sequence_length_alignment=sequence_length_alignment,
+            fixed_seq_length=fixed_seq_length,
+            ignore_index=ignore_index,
+            mlm_prob=mlm_prob,
+            emit_loss_mask=emit_loss_mask,
+        )
+
+    if bin_ids:
+        if fixed_seq_lengths is not None:
+            if len(fixed_seq_lengths) != len(bin_ids):
+                raise ValueError(
+                    "fixed_seq_lengths has {} entries for {} bins".format(
+                        len(fixed_seq_lengths), len(bin_ids)))
+        else:
+            fixed_seq_lengths = [None] * len(bin_ids)
+        loaders = [
+            DataLoader(
+                make_dataset(get_file_paths_for_bin_id(file_paths, b)),
+                batch_size,
+                collate_fn=make_collate(fixed_seq_lengths[b]),
+                prefetch=prefetch,
+            ) for b in bin_ids
+        ]
+        return BertPretrainBinned(loaders,
+                                  base_seed=base_seed,
+                                  start_epoch=start_epoch,
+                                  logger=logger)
+    fixed = fixed_seq_lengths
+    if isinstance(fixed, (list, tuple)):
+        if len(fixed) != 1:
+            raise ValueError("unbinned data takes a single fixed_seq_length")
+        fixed = fixed[0]
+    return DataLoader(
+        make_dataset(file_paths),
+        batch_size,
+        collate_fn=make_collate(fixed),
+        prefetch=prefetch,
+    )

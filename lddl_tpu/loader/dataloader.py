@@ -1,0 +1,160 @@
+"""Batch iteration: worker-threaded DataLoader + synchronized Binned wrapper.
+
+Reference parity: lddl/torch/dataloader.py. The reference rides
+torch.utils.data.DataLoader worker *processes*; our workers are threads —
+the hot per-sample work (pyarrow decode, HF fast tokenizer) releases the
+GIL, and thread workers keep the determinism contract trivially (a FIFO
+queue per worker + fixed round-robin service order reproduces the exact
+batch order of a synchronous run).
+"""
+
+import queue
+import threading
+
+from ..utils import rng as lrng
+from ..utils.logging import DatasetLogger
+
+
+class DataLoader:
+    """Iterates a ParquetDataset in batches.
+
+    Epoch advance happens on ``__iter__`` (via dataset.start_epoch), like
+    the reference's IterableDataset. Worker w collates its own stream into
+    batches; the loader serves worker batches round-robin, so batch order
+    is a pure function of (base_seed, epoch).
+    """
+
+    def __init__(self, dataset, batch_size, collate_fn=None, prefetch=2):
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._collate_fn = collate_fn or (lambda samples: samples)
+        self._prefetch = max(1, prefetch)
+
+    @property
+    def num_batches_per_worker(self):
+        num_files_per_worker = (self.dataset.num_files_per_group //
+                                self.dataset.num_workers)
+        samples_per_worker = (self.dataset.num_samples_per_file *
+                              num_files_per_worker)
+        return (samples_per_worker - 1) // self.batch_size + 1
+
+    def __len__(self):
+        """Batches per epoch, accounting for each worker's final partial
+        batch. (ref: lddl/torch/dataloader.py:96-105)"""
+        return self.num_batches_per_worker * self.dataset.num_workers
+
+    # Domain tag for per-worker collation RNG streams (dynamic masking) —
+    # distinct from the shuffle-buffer streams so the two never correlate.
+    _COLLATE_RNG_TAG = 0xC011
+
+    def _worker_loop(self, stream, out_q, stop, collate):
+        def put(item):
+            # Bounded-queue put that gives up if the consumer abandoned the
+            # epoch (e.g. partial iteration) so threads never leak blocked.
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            batch = []
+            for sample in stream:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    if not put(("batch", collate(batch))):
+                        return
+                    batch = []
+            if batch:
+                if not put(("batch", collate(batch))):
+                    return
+            put(("end", None))
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+            put(("error", e))
+
+    def _bind_collate(self, worker_idx):
+        """Bind a per-(epoch, dp group, worker) RNG stream into the collate
+        when it asks for one (dynamic masking)."""
+        if not getattr(self._collate_fn, "needs_rng", False):
+            return self._collate_fn
+        ds = self.dataset
+        g = lrng.sample_rng(ds.base_seed, self._COLLATE_RNG_TAG, ds.epoch,
+                            ds.dp_rank, worker_idx)
+        return lambda batch: self._collate_fn(batch, g=g)
+
+    def __iter__(self):
+        streams = self.dataset.start_epoch()
+        stop = threading.Event()
+        queues = [queue.Queue(maxsize=self._prefetch) for _ in streams]
+        threads = [
+            threading.Thread(target=self._worker_loop,
+                             args=(s, q, stop, self._bind_collate(w)),
+                             daemon=True)
+            for w, (s, q) in enumerate(zip(streams, queues))
+        ]
+        for t in threads:
+            t.start()
+        live = list(range(len(queues)))
+        try:
+            while live:
+                for w in list(live):
+                    kind, payload = queues[w].get()
+                    if kind == "error":
+                        raise payload
+                    if kind == "end":
+                        live.remove(w)
+                        continue
+                    yield payload
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=5)
+
+
+class Binned:
+    """One DataLoader per sequence-length bin; every iteration all ranks
+    draw the same bin from the world RNG stream, weighted by remaining
+    samples — identical choice with zero communication.
+    (ref: lddl/torch/dataloader.py:32-91)
+    """
+
+    def __init__(self, dataloaders, base_seed=12345, start_epoch=0,
+                 logger=None):
+        self._dataloaders = dataloaders
+        self._base_seed = base_seed
+        self._epoch = start_epoch - 1
+        self._logger = logger or DatasetLogger()
+
+    def __len__(self):
+        return sum(len(dl) for dl in self._dataloaders)
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def _get_batch_size(self, batch):
+        raise NotImplementedError("Binned is abstract: use a subclass that "
+                                  "knows the batch structure")
+
+    def __iter__(self):
+        self._epoch += 1
+        world_g = lrng.world_rng(self._base_seed, self._epoch)
+        remaining = [len(dl.dataset) for dl in self._dataloaders]
+        iters = [iter(dl) for dl in self._dataloaders]
+        for i in range(len(self)):
+            bin_id = lrng.choices(world_g,
+                                  list(range(len(iters))),
+                                  weights=remaining)[0]
+            self._logger.to("rank").info(
+                "iteration {} selects bin {}".format(i, bin_id))
+            assert remaining[bin_id] > 0
+            batch = next(iters[bin_id])
+            remaining[bin_id] -= self._get_batch_size(batch)
+            yield batch
+        assert sum(remaining) == 0, (
+            "bin bookkeeping out of sync: {} samples unaccounted".format(
+                sum(remaining)))

@@ -1,0 +1,229 @@
+"""Streaming shard datasets with deterministic epoch-seeded shuffling.
+
+Reference parity: lddl/torch/datasets.py and the model-parallel
+generalization lddl/torch_mp/datasets.py. One implementation covers both:
+sharding is always by *data-parallel group* (``dp_rank``), which equals the
+global rank in plain DP and the Megatron dp_rank in 3D-parallel layouts —
+on TPU both fall out of the device mesh (see loader/sharding.py).
+
+Determinism contract (ref: lddl/torch/datasets.py:227-286):
+- Epoch k derives every random choice from (base_seed, epoch):
+  a world-identical file shuffle, then per-(dp_rank, worker) streams for
+  the shuffle buffer. Restarting with ``start_epoch=k`` reproduces epoch k
+  exactly — resume is recomputation by seeding, no state files.
+- All ranks of one dp group draw identical files, buffers, batches.
+"""
+
+import os
+
+import pyarrow.parquet as pq
+
+from ..parallel.distributed import LocalCommunicator
+from ..utils import rng as lrng
+from ..utils.fs import (
+    get_num_samples_of_parquet,
+    read_num_samples_cache,
+)
+from ..utils.logging import DatasetLogger
+from ..utils.types import File
+
+
+class ShuffleBuffer:
+    """Streaming shuffle: warmup fills the buffer at ``warmup_factor``:1,
+    then each new sample swap-replaces a random buffered sample, which is
+    yielded; the tail is shuffled and drained.
+    (ref: lddl/torch/datasets.py:46-109)
+    """
+
+    def __init__(self, files, max_num_samples_to_yield, decode_record_batch,
+                 size, warmup_factor, g, logger=None):
+        num_wasted = sum(f.num_samples for f in files) - max_num_samples_to_yield
+        assert 0 <= num_wasted <= len(files)
+        self._files = files
+        self._max_num_samples_to_yield = max_num_samples_to_yield
+        self._decode_record_batch = decode_record_batch
+        self._size = size
+        self._warmup_factor = warmup_factor
+        self._g = g
+        self._logger = logger
+
+    @property
+    def num_samples(self):
+        return sum(f.num_samples for f in self._files)
+
+    def __iter__(self):
+        buffer = []
+        num_to_yield = min(self._max_num_samples_to_yield, self.num_samples)
+        remaining = num_to_yield
+
+        for f in self._files:
+            if self._logger is not None:
+                self._logger.to("worker").info("Reading {}".format(f.path))
+            for record_batch in pq.read_table(f.path).to_batches():
+                for sample in self._decode_record_batch(record_batch):
+                    if remaining <= 0:
+                        return
+                    warmup_cap = (num_to_yield - remaining + 1) * self._warmup_factor
+                    if len(buffer) >= min(self._size, warmup_cap):
+                        idx = int(self._g.integers(0, len(buffer)))
+                        yield buffer[idx]
+                        buffer[idx] = sample
+                        remaining -= 1
+                    else:
+                        buffer.append(sample)
+        lrng.shuffle(self._g, buffer)
+        for sample in buffer:
+            if remaining <= 0:
+                return
+            yield sample
+            remaining -= 1
+
+
+class ParquetDataset:
+    """Balanced parquet shards -> per-(dp_rank, worker) sample streams.
+
+    ``file_paths`` must be the balanced output of lddl_tpu.balance (all
+    counts equal ±1); files are truncated to the min count so every dp
+    group sees exactly the same number of samples per epoch.
+    """
+
+    def __init__(
+        self,
+        file_paths,
+        base_seed=12345,
+        start_epoch=0,
+        dp_rank=0,
+        num_dp_groups=1,
+        num_workers=1,
+        shuffle_buffer_size=16384,
+        shuffle_buffer_warmup_factor=16,
+        decode_record_batch=None,
+        transform=None,
+        comm=None,
+        logger=None,
+    ):
+        if decode_record_batch is None:
+            raise ValueError("decode_record_batch is required")
+        if not file_paths:
+            raise ValueError("no input shard files")
+        num_workers = max(1, num_workers)
+        if len(file_paths) % num_dp_groups != 0:
+            raise ValueError(
+                "{} files not divisible by {} data-parallel groups".format(
+                    len(file_paths), num_dp_groups))
+        if (len(file_paths) // num_dp_groups) % num_workers != 0:
+            raise ValueError(
+                "{} files per dp group not divisible by {} workers".format(
+                    len(file_paths) // num_dp_groups, num_workers))
+        self._base_seed = base_seed
+        self._epoch = start_epoch - 1
+        self._dp_rank = dp_rank
+        self._num_dp_groups = num_dp_groups
+        self._num_workers = num_workers
+        self._shuffle_buffer_size = shuffle_buffer_size
+        self._shuffle_buffer_warmup_factor = shuffle_buffer_warmup_factor
+        self._decode_record_batch = decode_record_batch
+        self._transform = transform
+        self._logger = logger or DatasetLogger()
+        self._files = self._census(sorted(file_paths),
+                                   comm or LocalCommunicator())
+
+        counts = [f.num_samples for f in self._files]
+        lo, hi = min(counts), max(counts)
+        if not (lo == hi or lo + 1 == hi):
+            raise ValueError(
+                "input shards not balanced (counts range {}..{}); run "
+                "lddl_tpu.balance first".format(lo, hi))
+        if lo == 0:
+            raise ValueError("input shards contain empty files")
+        # Truncate to the min count so every file contributes equally.
+        self._num_samples_per_file = lo
+        lost = sum(counts) - lo * len(self._files)
+        if lost:
+            self._logger.to("rank").warning(
+                "dropping {} sample(s) to equalize shard counts".format(lost))
+
+    def _census(self, file_paths, comm):
+        """Per-file counts from the .num_samples.json cache; strided footer
+        reads + allreduce when the cache is missing/incomplete.
+        (ref: lddl/torch/datasets.py:161-195)"""
+        dir_counts = {}
+        for d in {os.path.dirname(p) for p in file_paths}:
+            cached = read_num_samples_cache(d)
+            if cached:
+                for name, n in cached.items():
+                    dir_counts[os.path.join(d, name)] = n
+        if all(p in dir_counts for p in file_paths):
+            return [File(p, int(dir_counts[p])) for p in file_paths]
+        counts = [0] * len(file_paths)
+        for i in range(comm.rank, len(file_paths), comm.world_size):
+            counts[i] = get_num_samples_of_parquet(file_paths[i])
+        counts = comm.allreduce_sum(counts)
+        return [File(p, int(n)) for p, n in zip(file_paths, counts)]
+
+    @property
+    def base_seed(self):
+        return self._base_seed
+
+    @property
+    def dp_rank(self):
+        return self._dp_rank
+
+    @property
+    def num_dp_groups(self):
+        return self._num_dp_groups
+
+    @property
+    def num_files_per_group(self):
+        return len(self._files) // self._num_dp_groups
+
+    @property
+    def num_samples_per_file(self):
+        return self._num_samples_per_file
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def __len__(self):
+        """Samples one dp group sees per epoch."""
+        return self._num_samples_per_file * self.num_files_per_group
+
+    @property
+    def epoch(self):
+        return self._epoch
+
+    def start_epoch(self):
+        """Advance to the next epoch; returns per-worker sample streams.
+
+        The file shuffle uses the world stream — identical on every rank —
+        then this dp group takes ``files[dp_rank::num_dp_groups]`` and
+        worker w takes every num_workers-th of those.
+        """
+        self._epoch += 1
+        world_g = lrng.world_rng(self._base_seed, self._epoch)
+        files = list(self._files)
+        lrng.shuffle(world_g, files)
+        group_files = files[self._dp_rank::self._num_dp_groups]
+        streams = []
+        for w in range(self._num_workers):
+            worker_files = group_files[w::self._num_workers]
+            worker_g = lrng.worker_rng(self._base_seed, self._epoch,
+                                       self._dp_rank, self._num_dp_groups, w,
+                                       self._num_workers)
+            buf = ShuffleBuffer(
+                worker_files,
+                self._num_samples_per_file * len(worker_files),
+                self._decode_record_batch,
+                self._shuffle_buffer_size,
+                self._shuffle_buffer_warmup_factor,
+                worker_g,
+                logger=self._logger,
+            )
+            streams.append(self._transformed(buf))
+        return streams
+
+    def _transformed(self, stream):
+        if self._transform is None:
+            return iter(stream)
+        return (self._transform(s) for s in stream)

@@ -1,0 +1,101 @@
+"""Mesh-aware batch placement: host-local numpy -> globally-sharded jax.Array.
+
+This is the TPU-native generalization of the reference's dp_rank contract
+(lddl/torch_mp/): instead of the user wiring up process groups, everything
+derives from the device mesh —
+
+- ``process_dp_info(mesh)``: which data-parallel group does *this process*
+  feed, and how many groups are there? Processes whose addressable devices
+  cover the same batch blocks are TP/PP/SP peers: they get the same
+  dp_rank, hence identical host batches.
+- ``to_device_batch(batch, mesh)``: assemble each host's identical-or-
+  distinct local batch into one global jax.Array sharded over the mesh's
+  data axes (replicated over model axes) via
+  ``jax.make_array_from_process_local_data``.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import mesh_data_axes
+
+
+def _batch_block_of_device(mesh, coords, data_axes):
+    """Index of the batch block a device at ``coords`` consumes, i.e. its
+    position along the flattened data axes."""
+    block = 0
+    for axis in data_axes:
+        axis_idx = mesh.axis_names.index(axis)
+        block = block * mesh.devices.shape[axis_idx] + coords[axis_idx]
+    return block
+
+
+def process_dp_info(mesh):
+    """(dp_rank, num_dp_groups) of the calling process for ``mesh``.
+
+    Grouping rule: two processes belong to the same data-parallel group iff
+    their addressable mesh devices cover exactly the same set of batch
+    blocks. Groups are ordered by their smallest block so dp_rank is stable
+    and identical on every process.
+    """
+    data_axes = mesh_data_axes(mesh)
+    if not data_axes:
+        return 0, 1
+    blocks_by_process = {}
+    for coords in np.ndindex(*mesh.devices.shape):
+        device = mesh.devices[coords]
+        block = _batch_block_of_device(mesh, coords, data_axes)
+        blocks_by_process.setdefault(device.process_index, set()).add(block)
+
+    groups = {}
+    for proc, blocks in blocks_by_process.items():
+        groups.setdefault(frozenset(blocks), []).append(proc)
+    ordered = sorted(groups.keys(), key=min)
+    # Sanity: block sets must tile the batch without overlap.
+    seen = set()
+    for blocks in ordered:
+        if seen & blocks:
+            raise ValueError(
+                "mesh layout maps one batch block to multiple process "
+                "groups; choose a mesh whose data axes align with hosts")
+        seen |= blocks
+
+    this_process = jax.process_index()
+    for dp_rank, blocks in enumerate(ordered):
+        if this_process in groups[blocks]:
+            return dp_rank, len(ordered)
+    raise RuntimeError(
+        "process {} owns no devices in the mesh".format(this_process))
+
+
+def batch_sharding(mesh, rank=2):
+    """NamedSharding for a [batch, ...] array: dim 0 over the data axes,
+    everything else replicated."""
+    data_axes = mesh_data_axes(mesh)
+    spec = P(data_axes if data_axes else None, *([None] * (rank - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def to_device_batch(batch, mesh):
+    """Host-local numpy batch dict -> dict of global jax.Arrays sharded
+    over the mesh's data axes.
+
+    Every process passes the batch for its own dp group (identical within
+    a group); the result is the concatenated global batch of size
+    ``local_batch * num_dp_groups``, device-sharded without any gather.
+
+    IMPORTANT (multi-host): every process must supply arrays of identical
+    non-batch shape. Batch-max padding varies with each dp group's data, so
+    multi-group meshes must use the loader's ``fixed_seq_lengths`` (which
+    you want on TPU anyway — bounded XLA compilation count).
+    """
+    out = {}
+    for key, value in batch.items():
+        value = np.asarray(value)
+        sharding = batch_sharding(mesh, rank=value.ndim)
+        global_shape = None  # infer: local batch extends dim 0 per process
+        out[key] = jax.make_array_from_process_local_data(
+            sharding, value, global_shape)
+    return out

@@ -23,9 +23,6 @@ data, which is exactly the reference's dp_rank contract
 
 import numpy as np
 
-import jax
-from jax.sharding import Mesh
-
 AXIS_DP = "dp"
 AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
@@ -43,6 +40,10 @@ def make_mesh(axis_sizes, devices=None):
     Axis order follows insertion order of ``axis_sizes``. Axes of size 1 are
     kept — a consistent rank makes sharding rules simpler to write.
     """
+    # jax imported lazily: the offline pipeline stages (preprocess/balance)
+    # must be importable on machines where jax is absent or broken.
+    import jax
+    from jax.sharding import Mesh
     if devices is None:
         devices = jax.devices()
     n = len(devices)

@@ -132,7 +132,7 @@ def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
     written = {}
     if bin_size is None:
         path = os.path.join(out_dir, "{}.txt".format(part_id))
-        with open(path, "w") as f:
+        with open(path, "w", encoding="utf-8") as f:
             for r in rows:
                 f.write(fmt(r) + "\n")
         written[path] = len(rows)
@@ -144,7 +144,7 @@ def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
         by_bin.setdefault(b, []).append(r)
     for b, bin_rows in sorted(by_bin.items()):
         path = os.path.join(out_dir, "{}.txt_{}".format(part_id, b))
-        with open(path, "w") as f:
+        with open(path, "w", encoding="utf-8") as f:
             for r in bin_rows:
                 f.write(fmt(r) + "\n")
         written[path] = len(bin_rows)
@@ -165,7 +165,11 @@ def run_bert_preprocess(
     comm=None,
     log=None,
 ):
-    """Run the full BERT preprocessing pipeline; returns {path: num_rows}.
+    """Run the full BERT preprocessing pipeline.
+
+    Returns {path: num_rows} for the shards written by THIS rank (ranks
+    own disjoint buckets; the balancer performs the global census). The
+    completion log line reports globally-reduced totals.
 
     SPMD: call on every host with the same arguments; hosts split the work
     by ``comm`` rank and meet at barriers.
@@ -223,6 +227,7 @@ def run_bert_preprocess(
 
     if global_shuffle and comm.rank == 0:
         shutil.rmtree(os.path.join(out_dir, _SPOOL_DIR), ignore_errors=True)
+    totals = comm.allreduce_sum([len(written), sum(written.values())])
     log("preprocess done in {:.1f}s, {} shards, {} samples".format(
-        time.time() - t0, len(written), sum(written.values())))
+        time.time() - t0, int(totals[0]), int(totals[1])))
     return written

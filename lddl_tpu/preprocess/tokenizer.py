@@ -69,7 +69,7 @@ def build_wordpiece_vocab(texts, out_path, vocab_size=30000,
         tokens.extend(sorted(chars))
         tokens.extend(
             w for w, c in counter.most_common(vocab_size) if c >= min_frequency)
-    with open(out_path, "w") as f:
+    with open(out_path, "w", encoding="utf-8") as f:
         for t in tokens:
             f.write(t + "\n")
     return out_path

@@ -1,0 +1,253 @@
+"""Loader: shuffle buffer, determinism, dp-group sharding, binning sync,
+dynamic masking, mesh placement."""
+
+import numpy as np
+import pytest
+
+from lddl_tpu.loader import (
+    ShuffleBuffer,
+    get_bert_pretrain_data_loader,
+    process_dp_info,
+    to_device_batch,
+)
+from lddl_tpu.utils import rng as lrng
+from lddl_tpu.utils.types import File
+
+
+@pytest.fixture(scope="module")
+def pipeline(tmp_path_factory):
+    """corpus -> vocab -> preprocess (unbinned dynamic + binned static)
+    -> balanced shards, shared by all loader tests."""
+    import numpy as np
+    root = tmp_path_factory.mktemp("pipeline")
+    source = root / "corpus" / "source"
+    source.mkdir(parents=True)
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+             "lambda mu nu xi omicron pi rho sigma tau upsilon").split()
+    g = np.random.Generator(np.random.Philox(key=[0, 11]))
+    docs = []
+    for d in range(60):
+        sents = []
+        for _ in range(int(g.integers(2, 8))):
+            n = int(g.integers(4, 12))
+            sents.append(" ".join(
+                words[int(g.integers(0, len(words)))] for _ in range(n)
+            ).capitalize() + ".")
+        docs.append("doc-{} {}".format(d, " ".join(sents)))
+    for shard in range(3):
+        with open(source / "{}.txt".format(shard), "w") as f:
+            for line in docs[shard::3]:
+                f.write(line + "\n")
+
+    from lddl_tpu.preprocess import (BertPretrainConfig, build_wordpiece_vocab,
+                                     get_tokenizer, run_bert_preprocess)
+    from lddl_tpu.balance import balance_shards
+    vocab = build_wordpiece_vocab([" ".join(words)] * 3,
+                                  str(root / "vocab.txt"), vocab_size=300)
+    tok = get_tokenizer(vocab_file=vocab)
+
+    run_bert_preprocess(
+        {"wiki": str(root / "corpus")}, str(root / "pre_dyn"), tok,
+        config=BertPretrainConfig(max_seq_length=64, duplicate_factor=2),
+        num_blocks=4, sample_ratio=1.0, seed=0)
+    balance_shards(str(root / "pre_dyn"), str(root / "bal_dyn"), 4)
+
+    run_bert_preprocess(
+        {"wiki": str(root / "corpus")}, str(root / "pre_bin"), tok,
+        config=BertPretrainConfig(max_seq_length=64, duplicate_factor=2,
+                                  masking=True),
+        num_blocks=4, sample_ratio=1.0, seed=0, bin_size=16)
+    balance_shards(str(root / "pre_bin"), str(root / "bal_bin"), 4)
+
+    return {"root": root, "vocab": vocab, "tokenizer": tok,
+            "dyn": str(root / "bal_dyn"), "bin": str(root / "bal_bin")}
+
+
+def test_shuffle_buffer_yields_all(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    path = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({"A": [str(i) for i in range(100)]}), path)
+
+    def decode(b):
+        for v in b.column("A").to_pylist():
+            yield v
+
+    buf = ShuffleBuffer([File(path, 100)], 100, decode, size=16,
+                        warmup_factor=2, g=lrng.sample_rng(0, 1))
+    out = list(buf)
+    assert sorted(out, key=int) == [str(i) for i in range(100)]
+    assert out != [str(i) for i in range(100)]  # actually shuffled
+    # Deterministic under the same stream.
+    buf2 = ShuffleBuffer([File(path, 100)], 100, decode, size=16,
+                         warmup_factor=2, g=lrng.sample_rng(0, 1))
+    assert list(buf2) == out
+    # Truncation respected.
+    buf3 = ShuffleBuffer([File(path, 100)], 99, decode, size=16,
+                         warmup_factor=2, g=lrng.sample_rng(0, 1))
+    assert len(list(buf3)) == 99
+
+
+def _loader(pipeline, kind, **kw):
+    defaults = dict(
+        batch_size=16,
+        num_workers=1,
+        shuffle_buffer_size=64,
+        shuffle_buffer_warmup_factor=4,
+        vocab_file=pipeline["vocab"],
+        base_seed=7,
+    )
+    defaults.update(kw)
+    return get_bert_pretrain_data_loader(pipeline[kind], **defaults)
+
+
+def test_unbinned_loader_shapes(pipeline):
+    loader = _loader(pipeline, "dyn")
+    batches = list(loader)
+    assert len(batches) == len(loader)
+    total = sum(len(b["input_ids"]) for b in batches)
+    assert total == len(loader.dataset)
+    for b in batches:
+        n, L = b["input_ids"].shape
+        assert L % 8 == 0  # sequence_length_alignment
+        assert b["token_type_ids"].shape == (n, L)
+        assert b["attention_mask"].shape == (n, L)
+        assert b["labels"].shape == (n, L)
+        assert b["next_sentence_labels"].shape == (n,)
+        # attention_mask marks a prefix; padding is zero.
+        assert ((b["input_ids"] != 0) <= (b["attention_mask"] == 1)).all()
+        # Dynamic masking produced some labels.
+    assert any((b["labels"] != -1).any() for b in batches)
+
+
+def test_epoch_determinism_and_resume(pipeline):
+    l1 = _loader(pipeline, "dyn")
+    e0 = [b["input_ids"] for b in l1]
+    e1 = [b["input_ids"] for b in l1]
+    # Same loader, consecutive epochs differ.
+    assert not all(
+        a.shape == b.shape and (a == b).all() for a, b in zip(e0, e1))
+    # Fresh loader reproduces epoch 0 exactly.
+    l2 = _loader(pipeline, "dyn")
+    f0 = [b["input_ids"] for b in l2]
+    assert len(e0) == len(f0)
+    for a, b in zip(e0, f0):
+        np.testing.assert_array_equal(a, b)
+    # Resume: start_epoch=1 reproduces the second epoch.
+    l3 = _loader(pipeline, "dyn", start_epoch=1)
+    g1 = [b["input_ids"] for b in l3]
+    for a, b in zip(e1, g1):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_dp_group_sharding(pipeline):
+    # TP/PP peers (same dp_rank) -> identical batches.
+    a = _loader(pipeline, "dyn", dp_rank=0, num_dp_groups=2)
+    b = _loader(pipeline, "dyn", dp_rank=0, num_dp_groups=2)
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(ba["input_ids"], bb["input_ids"])
+        np.testing.assert_array_equal(ba["labels"], bb["labels"])
+    # The two dp groups exactly partition the epoch: their sample multisets
+    # union to the full loader's multiset (content can repeat due to
+    # duplicate_factor, so compare multisets, not sets).
+    full = _loader(pipeline, "dyn", return_raw_samples=True)
+    a = _loader(pipeline, "dyn", dp_rank=0, num_dp_groups=2,
+                return_raw_samples=True)
+    c = _loader(pipeline, "dyn", dp_rank=1, num_dp_groups=2,
+                return_raw_samples=True)
+    sa = [s[0] + "|" + s[1] for batch in a for s in batch]
+    sc = [s[0] + "|" + s[1] for batch in c for s in batch]
+    sf = [s[0] + "|" + s[1] for batch in full for s in batch]
+    assert sa and sc
+    assert len(sa) == len(sc) == len(sf) // 2
+    # Which sample gets dropped at the truncation boundary may differ
+    # between layouts; everything else must match exactly.
+    import collections
+    ca = collections.Counter(sa + sc)
+    cf = collections.Counter(sf)
+    mismatch = sum(((ca - cf) + (cf - ca)).values())
+    assert mismatch <= 2
+
+
+def test_binned_loader_sync_and_shapes(pipeline):
+    fixed = [16, 32, 48, 64]
+    l1 = _loader(pipeline, "bin", fixed_seq_lengths=fixed)
+    l2 = _loader(pipeline, "bin", fixed_seq_lengths=fixed)
+    shapes = set()
+    picks1, picks2 = [], []
+    for b1, b2 in zip(l1, l2):
+        # Identical bin choice and content on a simulated second rank.
+        np.testing.assert_array_equal(b1["input_ids"], b2["input_ids"])
+        L = b1["input_ids"].shape[1]
+        shapes.add(L)
+        picks1.append(L)
+        lens = b1["attention_mask"].sum(axis=1)
+        # Every sample in the batch fits its bin's padded shape: static
+        # shapes bounded by the bin count.
+        assert (lens <= L).all()
+        assert L in fixed
+    assert len(shapes) >= 2
+    # Static masking path: labels decoded from stored positions.
+    assert any((b["labels"] != -1).any() for b in _loader(
+        pipeline, "bin", fixed_seq_lengths=fixed))
+
+
+def test_binned_loader_multi_worker_determinism(pipeline):
+    l1 = _loader(pipeline, "bin", num_workers=2)
+    l2 = _loader(pipeline, "bin", num_workers=2)
+    n = 0
+    for b1, b2 in zip(l1, l2):
+        np.testing.assert_array_equal(b1["input_ids"], b2["input_ids"])
+        n += 1
+    assert n == len(l1)
+
+
+def test_dynamic_masking_stats(pipeline):
+    loader = _loader(pipeline, "dyn", batch_size=32)
+    masked = 0
+    eligible = 0
+    mask_tok = 0
+    from lddl_tpu.preprocess import get_tokenizer
+    tok = get_tokenizer(vocab_file=pipeline["vocab"])
+    mask_id = tok.convert_tokens_to_ids("[MASK]")
+    for b in loader:
+        lab = b["labels"]
+        masked += (lab != -1).sum()
+        mask_tok += ((lab != -1) & (b["input_ids"] == mask_id)).sum()
+        eligible += b["attention_mask"].sum() - 3 * len(lab)
+    assert 0.10 < masked / eligible < 0.20
+    assert 0.75 < mask_tok / masked < 0.85
+
+
+def test_process_dp_info_single_process():
+    import jax
+    from lddl_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    dp_rank, num_groups = process_dp_info(mesh)
+    # Single process owns every device -> one group.
+    assert (dp_rank, num_groups) == (0, 1)
+
+
+def test_to_device_batch_mesh_sharding(pipeline):
+    import jax
+    from lddl_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    loader = _loader(pipeline, "dyn", batch_size=8)
+    batch = next(iter(loader))
+    global_batch = to_device_batch(batch, mesh)
+    arr = global_batch["input_ids"]
+    assert arr.shape == batch["input_ids"].shape
+    np.testing.assert_array_equal(np.asarray(arr), batch["input_ids"])
+    # Sharded over dp: each device holds batch/4 rows.
+    shard_shapes = {s.data.shape for s in arr.addressable_shards}
+    assert shard_shapes == {(2, batch["input_ids"].shape[1])}
+
+
+def test_loader_validation(pipeline):
+    with pytest.raises(ValueError, match="not divisible"):
+        _loader(pipeline, "dyn", num_dp_groups=3)
+    with pytest.raises(ValueError, match="not divisible"):
+        _loader(pipeline, "dyn", num_workers=3)
+    with pytest.raises(ValueError):
+        get_bert_pretrain_data_loader(
+            "/nonexistent", vocab_file=pipeline["vocab"])
