@@ -1,0 +1,109 @@
+"""Benchmark: BERT-pretrain preprocessing throughput (MB raw text/sec/chip).
+
+Mirrors the driver target in BASELINE.json: the Wikipedia BERT-pretrain
+preprocess hot path (sentence split -> WordPiece -> NSP pairs -> static MLM
+masking -> binned parquet shards).
+
+Baseline derivation (BASELINE.md): the reference preprocesses full English
+Wikipedia (~12.5 GB extracted text) in <120 s on 32 DGX-A100 nodes
+= 256 GPUs -> ~0.41 MB/s/chip. We run the same pipeline stage on a
+synthetic Wikipedia-like corpus and report MB/s on this host's single chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REFERENCE_MB_PER_SEC_PER_CHIP = 12500.0 / 120.0 / 256.0
+
+_WORDS = (
+    "the of and in to a is was for on as by with he she it at from his her "
+    "their this that which were are be has had not but also an or its new "
+    "first one two three time year years city state world war government "
+    "university school system national history people group member company "
+    "development research music film work life family house water area "
+    "north south east west century during between under about after before "
+    "known called made used found became included according population").split()
+
+
+def make_corpus(target_mb=24, shards=4, seed=0):
+    """Deterministic Wikipedia-like corpus: one doc per line, doc-id first."""
+    tmp = tempfile.mkdtemp(prefix="lddl_bench_")
+    source = os.path.join(tmp, "corpus", "source")
+    os.makedirs(source)
+    g = np.random.default_rng(seed)
+    target_bytes = int(target_mb * 1024 * 1024)
+    written = 0
+    doc_id = 0
+    files = [open(os.path.join(source, "{}.txt".format(i)), "w")
+             for i in range(shards)]
+    try:
+        while written < target_bytes:
+            n_sents = int(g.integers(8, 40))
+            sents = []
+            for _ in range(n_sents):
+                n = int(g.integers(8, 30))
+                words = [_WORDS[int(g.integers(0, len(_WORDS)))]
+                         for _ in range(n)]
+                sents.append(" ".join(words).capitalize() + ".")
+            line = "wiki-{} {}\n".format(doc_id, " ".join(sents))
+            f = files[doc_id % shards]
+            f.write(line)
+            written += len(line)
+            doc_id += 1
+    finally:
+        for f in files:
+            f.close()
+    return tmp, written
+
+
+def main():
+    target_mb = float(os.environ.get("BENCH_MB", "24"))
+    tmp, corpus_bytes = make_corpus(target_mb=target_mb)
+    try:
+        from lddl_tpu.preprocess import (BertPretrainConfig,
+                                         build_wordpiece_vocab, get_tokenizer,
+                                         run_bert_preprocess)
+        vocab = build_wordpiece_vocab(
+            [" ".join(_WORDS)] * 8, os.path.join(tmp, "vocab.txt"),
+            vocab_size=4096)
+        tokenizer = get_tokenizer(vocab_file=vocab)
+
+        out_dir = os.path.join(tmp, "out")
+        t0 = time.time()
+        written = run_bert_preprocess(
+            {"wikipedia": os.path.join(tmp, "corpus")},
+            out_dir,
+            tokenizer,
+            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
+                                      masking=True),
+            num_blocks=8,
+            sample_ratio=1.0,
+            seed=12345,
+            bin_size=32,
+        )
+        elapsed = time.time() - t0
+        n_samples = sum(written.values())
+        assert n_samples > 0
+
+        mb = corpus_bytes / 1024 / 1024
+        value = mb / elapsed
+        print(json.dumps({
+            "metric": "MB raw text/sec/chip (Wiki BERT-pretrain preprocess)",
+            "value": round(value, 4),
+            "unit": "MB/s/chip",
+            "vs_baseline": round(value / REFERENCE_MB_PER_SEC_PER_CHIP, 3),
+        }))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

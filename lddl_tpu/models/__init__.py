@@ -1,0 +1,16 @@
+from .bert import BertConfig, BertForPreTraining
+from .train import (
+    TrainState,
+    create_train_state,
+    make_sharded_train_step,
+    pretrain_loss,
+)
+
+__all__ = [
+    "BertConfig",
+    "BertForPreTraining",
+    "TrainState",
+    "create_train_state",
+    "make_sharded_train_step",
+    "pretrain_loss",
+]

@@ -1,0 +1,259 @@
+"""BERT for pretraining (MLM + NSP), TPU-first.
+
+The reference ships no models — its "training" is a mock loop
+(benchmarks/torch_train.py) that only consumes batches. lddl_tpu includes a
+real reference consumer so the loader's contract (shapes, masking, binning)
+is exercised by an actual jitted forward/backward on a device mesh, and so
+benchmarks can measure end-to-end step time rather than loader time alone.
+
+TPU design notes:
+- bf16 activations, fp32 params/optimizer — MXU-native without loss-scale
+  bookkeeping.
+- Megatron-style tensor parallelism via flax logical axis names:
+  QKV/MLP-in are column-parallel ("mlp"/"heads" -> tp), attention-out and
+  MLP-out are row-parallel. XLA inserts the psums.
+- Sequence parallelism: activations carry a "seq" logical axis; with the
+  seq->sp rule, layernorm/embedding/dropout regions run sequence-sharded
+  and XLA all-gathers only around attention (the Megatron-SP pattern),
+  riding ICI.
+- Everything static-shape; the loader's per-bin fixed lengths bound the
+  compilation count.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+# Logical-to-mesh sharding rules (see lddl_tpu.parallel.mesh for axes).
+LOGICAL_AXIS_RULES = (
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("embed", None),
+    ("embed_out", None),
+    ("mlp", "tp"),
+    ("heads", "tp"),
+    ("kv", None),
+    ("vocab", "tp"),
+)
+
+with_logical = nn.with_logical_constraint
+
+
+def axis_rules_for(mesh):
+    """LOGICAL_AXIS_RULES restricted to the axes ``mesh`` actually has, so
+    one model definition runs on any mesh (dp-only, dp×tp, dp×tp×sp, ...).
+    """
+    rules = []
+    for logical, target in LOGICAL_AXIS_RULES:
+        if isinstance(target, tuple):
+            present = tuple(a for a in target if a in mesh.axis_names)
+            rules.append((logical, present if present else None))
+        elif target is not None and target not in mesh.axis_names:
+            rules.append((logical, None))
+        else:
+            rules.append((logical, target))
+    return tuple(rules)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    dtype: Any = jnp.bfloat16  # activations; params stay fp32
+
+    @staticmethod
+    def bert_base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def bert_large(**kw):
+        kw.setdefault("hidden_size", 1024)
+        kw.setdefault("num_layers", 24)
+        kw.setdefault("num_heads", 16)
+        kw.setdefault("intermediate_size", 4096)
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        """For tests and dryruns."""
+        kw.setdefault("vocab_size", 512)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("max_position_embeddings", 128)
+        return BertConfig(**kw)
+
+
+def _dense_init(cfg):
+    return nn.initializers.normal(stddev=cfg.initializer_range)
+
+
+class Embeddings(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, deterministic):
+        cfg = self.cfg
+        word = nn.Embed(
+            cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("vocab", "embed")),
+            name="word_embeddings")(input_ids)
+        position_ids = jnp.arange(input_ids.shape[1])[None, :]
+        pos = nn.Embed(
+            cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                _dense_init(cfg), (None, "embed")),
+            name="position_embeddings")(position_ids)
+        typ = nn.Embed(
+            cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
+            embedding_init=nn.with_logical_partitioning(
+                _dense_init(cfg), (None, "embed")),
+            name="token_type_embeddings")(token_type_ids)
+        x = word + pos + typ
+        x = with_logical(x, ("batch", "seq", "embed"))
+        x = nn.LayerNorm(epsilon=self.cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="layer_norm")(x)
+        x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
+        return x
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+
+        def qkv_proj(name):
+            # Column-parallel: the flat (heads*head_dim) output dim shards
+            # over tp ("heads"); reshaped to [B, L, H, D] after.
+            return nn.Dense(
+                cfg.num_heads * head_dim, dtype=cfg.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("embed", "heads")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("heads",)),
+                name=name)
+
+        def split_heads(t):
+            t = t.reshape(t.shape[0], t.shape[1], cfg.num_heads, head_dim)
+            return with_logical(t, ("batch", None, "heads", "kv"))
+
+        # Attention computes over the full sequence: entering this block the
+        # activations all-gather from sp, and heads shard over tp.
+        q = split_heads(qkv_proj("query")(x))
+        k = split_heads(qkv_proj("key")(x))
+        v = split_heads(qkv_proj("value")(x))
+
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            head_dim).astype(cfg.dtype)
+        # Finite large-negative (not dtype-min): fp32 min overflows to -inf
+        # in bf16, and an all-masked row would then softmax to NaN.
+        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                         -1e9).astype(cfg.dtype)
+        probs = nn.softmax(scores + bias, axis=-1)
+        probs = nn.Dropout(cfg.attention_dropout)(
+            probs, deterministic=deterministic)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
+                          cfg.num_heads * head_dim)
+
+        # Row-parallel: input dim sharded over tp, XLA psums the output.
+        out = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("heads", "embed")),
+            name="output")(ctx)
+        return with_logical(out, ("batch", "seq", "embed"))
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask, deterministic):
+        cfg = self.cfg
+        attn = SelfAttention(cfg, name="attention")(
+            x, attention_mask, deterministic)
+        attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="attention_norm")(x + attn)
+
+        h = nn.Dense(
+            cfg.intermediate_size, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("mlp",)),
+            name="intermediate")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("mlp", "embed")),
+            name="ffn_output")(h)
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="ffn_norm")(x + h)
+        return with_logical(x, ("batch", "seq", "embed"))
+
+
+class BertForPreTraining(nn.Module):
+    """Encoder + MLM head + NSP head.
+
+    Returns (mlm_logits [B,L,vocab], nsp_logits [B,2]) in fp32.
+    """
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids, attention_mask,
+                 deterministic=True):
+        cfg = self.cfg
+        x = Embeddings(cfg, name="embeddings")(
+            input_ids, token_type_ids, deterministic)
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, name="layer_{}".format(i))(
+                x, attention_mask, deterministic)
+
+        # MLM head: transform + tied-free decoder to vocab (column-parallel).
+        h = nn.Dense(
+            cfg.hidden_size, dtype=cfg.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", "embed_out")),
+            name="mlm_transform")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
+                         name="mlm_norm")(h)
+        mlm_logits = nn.Dense(
+            cfg.vocab_size, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", "vocab")),
+            name="mlm_decoder")(h)
+
+        # NSP head over the [CLS] position.
+        pooled = nn.tanh(
+            nn.Dense(
+                cfg.hidden_size, dtype=cfg.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    _dense_init(cfg), ("embed", "embed_out")),
+                name="pooler")(x[:, 0]))
+        nsp_logits = nn.Dense(
+            2, dtype=jnp.float32,
+            kernel_init=nn.with_logical_partitioning(
+                _dense_init(cfg), ("embed", None)),
+            name="nsp_classifier")(pooled)
+        return mlm_logits, nsp_logits

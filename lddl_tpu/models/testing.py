@@ -1,0 +1,25 @@
+"""Synthetic batches matching the loader's BERT pretraining contract.
+
+One definition shared by tests, the driver compile-check entry, and the
+multichip dryrun, so contract changes (new keys, dtypes) propagate
+everywhere at once.
+"""
+
+import numpy as np
+
+
+def fake_pretrain_batch(vocab_size, batch, seq_len, seed=0,
+                        segment_split=False):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, vocab_size, (batch, seq_len)).astype(np.int32)
+    segment = np.zeros((batch, seq_len), np.int32)
+    if segment_split:
+        segment[:, seq_len // 2:] = 1
+    return {
+        "input_ids": ids,
+        "token_type_ids": segment,
+        "attention_mask": np.ones((batch, seq_len), np.int32),
+        "labels": np.where(rng.random((batch, seq_len)) < 0.15, ids,
+                           -1).astype(np.int32),
+        "next_sentence_labels": rng.integers(0, 2, (batch,)).astype(np.int32),
+    }

@@ -1,0 +1,233 @@
+"""Sharded pretraining step: loss, optimizer, pjit wiring.
+
+The full consumer of the loader contract: batches (from
+lddl_tpu.loader.to_device_batch) -> jitted forward/backward on an arbitrary
+mesh, with params/opt-state sharded by the model's logical axis rules and
+the batch sharded over the data axes. All collectives are XLA-inserted
+(psum for row-parallel matmuls and the data-parallel grad reduction,
+all-gather around the sequence-sharded regions).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+import flax.linen as nn
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .bert import BertForPreTraining, axis_rules_for
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray
+    params: dict
+    opt_state: optax.OptState
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state,
+                                                self.params)
+        return self.replace(
+            step=self.step + 1,
+            params=optax.apply_updates(self.params, updates),
+            opt_state=new_opt_state,
+        )
+
+
+def pretrain_loss(mlm_logits, nsp_logits, labels, next_sentence_labels,
+                  ignore_index=-1):
+    """Masked-LM cross entropy (mean over masked positions) + NSP cross
+    entropy. Returns (loss, metrics dict)."""
+    mask = labels != ignore_index
+    safe_labels = jnp.where(mask, labels, 0)
+    mlm_ll = optax.softmax_cross_entropy_with_integer_labels(
+        mlm_logits, safe_labels)
+    denom = jnp.maximum(mask.sum(), 1)
+    mlm_loss = jnp.where(mask, mlm_ll, 0.0).sum() / denom
+    nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
+        nsp_logits, next_sentence_labels).mean()
+    loss = mlm_loss + nsp_loss
+    mlm_correct = jnp.where(
+        mask, jnp.argmax(mlm_logits, axis=-1) == safe_labels, False)
+    metrics = {
+        "loss": loss,
+        "mlm_loss": mlm_loss,
+        "nsp_loss": nsp_loss,
+        "mlm_accuracy": mlm_correct.sum() / denom,
+        "nsp_accuracy":
+            (jnp.argmax(nsp_logits, -1) == next_sentence_labels).mean(),
+    }
+    return loss, metrics
+
+
+def make_optimizer(learning_rate=1e-4, weight_decay=0.01, warmup_steps=100,
+                   total_steps=10000, b1=0.9, b2=0.999, clip_norm=1.0):
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(clip_norm),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+def _init_variables(model, rng, sample_batch):
+    return model.init(
+        {"params": rng},
+        sample_batch["input_ids"],
+        sample_batch["token_type_ids"],
+        sample_batch["attention_mask"],
+        deterministic=True,
+    )
+
+
+def param_shardings_of(mesh, model, sample_batch, abstract_variables=None):
+    """NamedShardings for the (unboxed) param pytree, derived from the
+    model's logical axis annotations + the mesh-filtered axis rules."""
+    if abstract_variables is None:
+        abstract_variables = jax.eval_shape(
+            lambda rng: _init_variables(model, rng, sample_batch),
+            jax.random.PRNGKey(0))
+    logical_specs = nn.get_partition_spec(abstract_variables)["params"]
+    return nn.logical_to_mesh_sharding(logical_specs, mesh,
+                                       axis_rules_for(mesh))
+
+
+def _mirror_param_shardings(opt_state, param_treedef, param_shardings,
+                            replicated):
+    """Opt-state subtrees structured like the param tree (adam mu/nu) get
+    the param shardings; everything else replicates."""
+    def matches(node):
+        try:
+            return jax.tree.structure(node) == param_treedef
+        except Exception:
+            return False
+
+    if matches(opt_state):
+        return param_shardings
+    if hasattr(opt_state, "_fields"):  # namedtuple optax state
+        return type(opt_state)(*[
+            _mirror_param_shardings(getattr(opt_state, f), param_treedef,
+                                    param_shardings, replicated)
+            for f in opt_state._fields
+        ])
+    if isinstance(opt_state, (tuple, list)):
+        return type(opt_state)(
+            _mirror_param_shardings(s, param_treedef, param_shardings,
+                                    replicated) for s in opt_state)
+    return jax.tree.map(lambda _: replicated, opt_state)
+
+
+def create_train_state(config, mesh, sample_batch, seed=0, optimizer=None,
+                       model=None):
+    """Initialize a sharded TrainState on ``mesh``.
+
+    Params materialize directly as shards (init runs under jit with the
+    target shardings), so models bigger than one device's memory
+    initialize fine. Returns (state, state_shardings).
+    """
+    model = model or BertForPreTraining(config)
+    tx = optimizer or make_optimizer()
+
+    def init_fn(rng):
+        variables = _init_variables(model, rng, sample_batch)
+        params = nn.meta.unbox(variables)["params"]
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            tx=tx,
+        )
+
+    # One abstract trace serves both the param shardings and the opt-state
+    # structure (tracing a large model twice costs seconds of startup).
+    abstract_vars = jax.eval_shape(
+        lambda rng: _init_variables(model, rng, sample_batch),
+        jax.random.PRNGKey(seed))
+    param_shardings = param_shardings_of(mesh, model, sample_batch,
+                                         abstract_variables=abstract_vars)
+    abstract_params = nn.meta.unbox(abstract_vars)["params"]
+    abstract_opt = jax.eval_shape(tx.init, abstract_params)
+    replicated = NamedSharding(mesh, P())
+    shardings = TrainState(
+        step=replicated,
+        params=param_shardings,
+        opt_state=_mirror_param_shardings(
+            abstract_opt, jax.tree.structure(abstract_params),
+            param_shardings, replicated),
+        tx=tx,
+    )
+    with jax.set_mesh(mesh), nn.logical_axis_rules(
+            axis_rules_for(mesh)):
+        state = jax.jit(init_fn, out_shardings=shardings)(
+            jax.random.PRNGKey(seed))
+    return state, shardings
+
+
+def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
+                            donate=True):
+    """A jitted SPMD train step: (state, batch, seed) -> (state, metrics).
+
+    Batch arrays must be globally-sharded jax.Arrays over the mesh's data
+    axes (use lddl_tpu.loader.to_device_batch). Dropout randomness is
+    deterministic per (seed, step).
+    """
+    model = model or BertForPreTraining(config)
+
+    def step_fn(state, batch, seed):
+        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+
+        def loss_fn(params):
+            mlm_logits, nsp_logits = model.apply(
+                {"params": params},
+                batch["input_ids"],
+                batch["token_type_ids"],
+                batch["attention_mask"],
+                deterministic=False,
+                rngs={"dropout": dropout_rng},
+            )
+            return pretrain_loss(mlm_logits, nsp_logits, batch["labels"],
+                                 batch["next_sentence_labels"],
+                                 ignore_index=ignore_index)
+
+        (_, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        new_state = state.apply_gradients(grads)
+        return new_state, metrics
+
+    jitted = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
+
+    def wrapped(state, batch, seed=0):
+        # Both contexts must be live at trace time: axis_rules resolves the
+        # logical constraints, use_mesh resolves bare PartitionSpecs.
+        with jax.set_mesh(mesh), nn.logical_axis_rules(
+                axis_rules_for(mesh)):
+            return jitted(state, batch, seed)
+
+    return wrapped
+
+
+def make_eval_step(mesh, config, model=None, ignore_index=-1):
+    """Jitted forward-only step returning metrics."""
+    model = model or BertForPreTraining(config)
+
+    def step_fn(params, batch):
+        mlm_logits, nsp_logits = model.apply(
+            {"params": params},
+            batch["input_ids"],
+            batch["token_type_ids"],
+            batch["attention_mask"],
+            deterministic=True,
+        )
+        _, metrics = pretrain_loss(mlm_logits, nsp_logits, batch["labels"],
+                                   batch["next_sentence_labels"],
+                                   ignore_index=ignore_index)
+        return metrics
+
+    jitted = jax.jit(step_fn)
+
+    def wrapped(params, batch):
+        with jax.set_mesh(mesh), nn.logical_axis_rules(
+                axis_rules_for(mesh)):
+            return jitted(params, batch)
+
+    return wrapped
