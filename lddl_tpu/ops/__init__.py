@@ -1,0 +1,16 @@
+from .masking import (
+    plan_num_to_predict,
+    mask_batch_numpy,
+    mask_batch_jax,
+    make_jax_masker,
+)
+from .packing import pad_to_bucket, round_up
+
+__all__ = [
+    "plan_num_to_predict",
+    "mask_batch_numpy",
+    "mask_batch_jax",
+    "make_jax_masker",
+    "pad_to_bucket",
+    "round_up",
+]

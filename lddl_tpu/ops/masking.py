@@ -1,0 +1,110 @@
+"""Batched static MLM masking kernels (numpy + jit'd JAX/TPU).
+
+Replaces the reference's per-row Python loop
+(lddl/dask/bert/pretrain.py:182-238) with whole-bucket batch kernels — the
+"tokenize/mask/bin as jit+vmap'd JAX" hot path from BASELINE.json.
+
+Algorithm (identical semantics across engines):
+- per row: num_to_predict = min(max_pred, max(1, round(seq_len * ratio)))
+- selection: uniform random subset of the non-special valid positions
+  (smallest-k of iid uniform scores == uniform subset without replacement)
+- per selected position: 80% -> [MASK], 10% -> uniform random vocab id,
+  10% -> keep original.
+
+The two engines consume different RNG streams (numpy Philox vs
+jax.random), so masks differ between engines but are each fully
+deterministic in (seed, bucket). Shard parity is defined per engine.
+"""
+
+import numpy as np
+
+
+def plan_num_to_predict(seq_lens, masked_lm_ratio, max_predictions_per_seq):
+    seq_lens = np.asarray(seq_lens)
+    return np.minimum(
+        max_predictions_per_seq,
+        np.maximum(1, np.round(seq_lens * masked_lm_ratio)),
+    ).astype(np.int32)
+
+
+def _ranks_from_scores(scores):
+    """Per-row rank of each column under ascending score order."""
+    order = np.argsort(scores, axis=1, kind="stable")
+    ranks = np.empty_like(order)
+    rows = np.arange(scores.shape[0])[:, None]
+    ranks[rows, order] = np.arange(scores.shape[1])[None, :]
+    return ranks
+
+
+def mask_batch_numpy(ids, candidate, num_to_predict, g, mask_id, vocab_size,
+                     random_token_low=0):
+    """Vectorized masking over a padded id matrix.
+
+    ids: [N, L] int32; candidate: [N, L] bool (valid AND non-special);
+    num_to_predict: [N] int. Returns (masked_ids, selected_mask).
+    """
+    scores = g.random(ids.shape)
+    scores[~candidate] = np.inf
+    ranks = _ranks_from_scores(scores)
+    selected = (ranks < num_to_predict[:, None]) & candidate
+
+    action = g.random(ids.shape)
+    random_ids = g.integers(random_token_low, vocab_size, ids.shape,
+                            dtype=np.int64).astype(np.int32)
+    out = np.where(selected & (action < 0.8), mask_id, ids)
+    out = np.where(selected & (action >= 0.8) & (action < 0.9), random_ids,
+                   out)
+    return out, selected
+
+
+def _mask_batch_jax_impl(ids, candidate, num_to_predict, key, mask_id,
+                         vocab_size, random_token_low):
+    import jax
+    import jax.numpy as jnp
+
+    k_sel, k_act, k_rand = jax.random.split(key, 3)
+    scores = jax.random.uniform(k_sel, ids.shape)
+    scores = jnp.where(candidate, scores, jnp.inf)
+    order = jnp.argsort(scores, axis=1)
+    ranks = jnp.zeros_like(order).at[
+        jnp.arange(ids.shape[0])[:, None], order].set(
+            jnp.arange(ids.shape[1])[None, :])
+    selected = (ranks < num_to_predict[:, None]) & candidate
+
+    action = jax.random.uniform(k_act, ids.shape)
+    random_ids = jax.random.randint(k_rand, ids.shape, random_token_low,
+                                    vocab_size, dtype=jnp.int32)
+    out = jnp.where(selected & (action < 0.8), mask_id, ids)
+    out = jnp.where(selected & (action >= 0.8) & (action < 0.9), random_ids,
+                    out)
+    return out, selected
+
+
+def make_jax_masker(mask_id, vocab_size, random_token_low=0):
+    """jit'd masking kernel; call with padded-to-bucket shapes so the
+    number of compilations stays bounded (see ops.packing.pad_to_bucket)."""
+    import jax
+    import functools
+
+    impl = functools.partial(
+        _mask_batch_jax_impl,
+        mask_id=mask_id,
+        vocab_size=vocab_size,
+        random_token_low=random_token_low,
+    )
+    jitted = jax.jit(impl)
+
+    def run(ids, candidate, num_to_predict, seed):
+        key = jax.random.key(np.uint32(seed))
+        out, selected = jitted(ids, candidate,
+                               np.asarray(num_to_predict, np.int32), key)
+        return np.asarray(out), np.asarray(selected)
+
+    return run
+
+
+def mask_batch_jax(ids, candidate, num_to_predict, seed, mask_id, vocab_size,
+                   random_token_low=0):
+    """One-shot convenience wrapper around make_jax_masker."""
+    run = make_jax_masker(mask_id, vocab_size, random_token_low)
+    return run(ids, candidate, num_to_predict, seed)

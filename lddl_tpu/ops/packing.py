@@ -1,0 +1,22 @@
+"""Shape utilities for TPU-friendly batching of ragged token sequences."""
+
+import numpy as np
+
+
+def round_up(n, multiple):
+    return ((n - 1) // multiple + 1) * multiple
+
+
+def pad_to_bucket(id_lists, pad_id=0, length_multiple=128, min_length=128):
+    """Ragged int lists -> (ids [N, L], valid [N, L]) with L rounded up to
+    ``length_multiple`` (TPU lane width) so the jit'd masking kernel sees a
+    bounded set of shapes."""
+    n = len(id_lists)
+    longest = max((len(x) for x in id_lists), default=1)
+    L = max(min_length, round_up(longest, length_multiple))
+    ids = np.full((n, L), pad_id, dtype=np.int32)
+    valid = np.zeros((n, L), dtype=bool)
+    for i, x in enumerate(id_lists):
+        ids[i, :len(x)] = x
+        valid[i, :len(x)] = True
+    return ids, valid

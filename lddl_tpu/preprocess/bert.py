@@ -1,14 +1,17 @@
 """BERT pretraining sample construction (NSP pairs + MLM masking).
 
-Reference parity: lddl/dask/bert/pretrain.py:49-441 — itself a port of
-Google BERT's ``create_pretraining_data``. This is an independent
-reimplementation of that public algorithm on top of lddl_tpu's counter-based
-RNG streams (lddl_tpu.utils.rng); the produced distribution matches the
-reference (target-length sampling with ``short_seq_prob``, sentence-chunk
+Reference parity: lddl/dask/bert/pretrain.py:49-441 — an independent
+reimplementation of Google BERT's ``create_pretraining_data`` distribution
+(target-length sampling with ``short_seq_prob``, sentence-chunk
 accumulation, random A/B split point, 50% random-next with segment
-put-back, random front/back pair truncation, 80/10/10 masking), while the
-exact random sequence follows our frozen RNG contract, not CPython's
-Mersenne Twister (SURVEY.md §7 "Byte-identical shards vs TPU RNG").
+put-back, random front/back truncation, 80/10/10 masking).
+
+TPU-first restructuring vs the reference: the whole pipeline is *token-id
+based* — sentences tokenize straight to ids, pair creation concatenates int
+lists, and static masking runs as ONE batched kernel per bucket
+(lddl_tpu.ops.masking: numpy engine or jit'd JAX on TPU) instead of a
+Python loop per row. Token strings are materialized only at the very end
+for the parquet columns.
 
 Output row schema (must match the reference sink,
 lddl/dask/bert/pretrain.py:451-471):
@@ -25,6 +28,8 @@ import dataclasses
 
 import numpy as np
 
+from ..ops.masking import mask_batch_numpy, make_jax_masker, plan_num_to_predict
+from ..ops.packing import pad_to_bucket
 from ..utils.fs import serialize_np_array
 from ..utils import rng as lrng
 from .sentences import split_sentences
@@ -39,37 +44,77 @@ class BertPretrainConfig:
     max_predictions_per_seq: int = None  # default: ceil(ratio * max_seq_len)
     whole_word_masking: bool = False
     duplicate_factor: int = 5
+    engine: str = "numpy"  # masking kernel: "numpy" | "jax"
 
     def __post_init__(self):
         if self.max_seq_length < 8:
             raise ValueError("max_seq_length too small")
+        if self.engine not in ("numpy", "jax"):
+            raise ValueError("engine must be numpy|jax")
         if self.max_predictions_per_seq is None:
             self.max_predictions_per_seq = int(
                 np.ceil(self.masked_lm_ratio * self.max_seq_length))
 
 
-def documents_from_texts(texts, tokenizer):
-    """Tokenize raw document texts into documents = lists of token-lists.
+class TokenizerInfo:
+    """Pre-extracted tokenizer tables the id-based pipeline needs."""
 
-    Sentence-splits each text, then WordPiece-tokenizes all sentences in one
-    batched fast-tokenizer call (the reference tokenizes sentence-by-
-    sentence, pretrain.py:77-97; batching is the first of the hot-path wins).
-    Documents that end up empty are dropped.
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        vocab = tokenizer.get_vocab()
+        size = max(vocab.values()) + 1
+        self.id_to_token = [None] * size
+        for tok, i in vocab.items():
+            self.id_to_token[i] = tok
+        self.id_to_token = np.asarray(
+            ["" if t is None else t for t in self.id_to_token], dtype=object)
+        self.cls_id = vocab["[CLS]"]
+        self.sep_id = vocab["[SEP]"]
+        self.mask_id = vocab["[MASK]"]
+        self.pad_id = vocab.get("[PAD]", 0)
+        self.vocab_size = size
+        # Random-replacement masking draws from the full vocab (matching
+        # Google's create_pretraining_data); the subword table supports
+        # whole-word masking.
+        self.is_subword = np.array(
+            [t.startswith("##") for t in self.id_to_token], dtype=bool)
+
+    def join(self, ids):
+        return " ".join(self.id_to_token[np.asarray(ids, dtype=np.int64)])
+
+
+def documents_from_texts(texts, tokenizer):
+    """Raw document texts -> documents as lists of per-sentence id lists.
+
+    All sentences of the block tokenize in one batched fast-tokenizer call
+    (the reference tokenizes sentence-by-sentence, pretrain.py:77-97).
     """
     doc_sentences = [split_sentences(t) for t in texts]
     flat = [s for sents in doc_sentences for s in sents]
     if not flat:
         return []
-    enc = tokenizer(flat, add_special_tokens=False, return_attention_mask=False)
+    backend = getattr(tokenizer, "_tokenizer", None)
+    if backend is not None:
+        # Rust fast path: skips transformers' per-encoding Python
+        # conversion (offsets/attention masks we never use).
+        try:
+            encs = backend.encode_batch_fast(flat, add_special_tokens=False)
+        except AttributeError:
+            encs = backend.encode_batch(flat, add_special_tokens=False)
+        all_ids = [e.ids for e in encs]
+    else:
+        enc = tokenizer(flat, add_special_tokens=False,
+                        return_attention_mask=False)
+        all_ids = enc["input_ids"]
     documents = []
     k = 0
     for sents in doc_sentences:
         doc = []
         for _ in sents:
-            tokens = enc.tokens(k)
+            ids = all_ids[k]
             k += 1
-            if tokens:
-                doc.append(tokens)
+            if ids:
+                doc.append(ids)
         if doc:
             documents.append(doc)
     return documents
@@ -90,60 +135,9 @@ def _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, g):
             trunc.pop()
 
 
-def create_masked_lm_predictions(tokens, vocab_words, g, masked_lm_ratio,
-                                 max_predictions_per_seq,
-                                 whole_word_masking=False):
-    """Apply static 80/10/10 MLM masking in place.
-
-    ``tokens`` is the full [CLS] A [SEP] B [SEP] list. Returns
-    (positions, labels): sorted masked positions and their original tokens.
-    """
-    cand_indexes = []
-    for i, token in enumerate(tokens):
-        if token in ("[CLS]", "[SEP]"):
-            continue
-        if (whole_word_masking and cand_indexes
-                and token.startswith("##")):
-            cand_indexes[-1].append(i)
-        else:
-            cand_indexes.append([i])
-
-    lrng.shuffle(g, cand_indexes)
-    num_to_predict = min(max_predictions_per_seq,
-                         max(1, int(round(len(tokens) * masked_lm_ratio))))
-
-    masked = []  # (position, original_token)
-    covered = set()
-    for index_set in cand_indexes:
-        if len(masked) >= num_to_predict:
-            break
-        if len(masked) + len(index_set) > num_to_predict:
-            continue
-        if any(i in covered for i in index_set):
-            continue
-        for i in index_set:
-            covered.add(i)
-            original = tokens[i]
-            r = g.random()
-            if r < 0.8:
-                tokens[i] = "[MASK]"
-            elif r < 0.9:
-                tokens[i] = vocab_words[int(g.integers(0, len(vocab_words)))]
-            # else: keep original
-            masked.append((i, original))
-    masked.sort(key=lambda x: x[0])
-    positions = [p for p, _ in masked]
-    labels = [t for _, t in masked]
-    return positions, labels
-
-
-def create_pairs_from_document(all_documents, document_index, config, g,
-                               vocab_words=None):
-    """Build NSP pair instances from one document.
-
-    ``all_documents``: the block's documents (population for random-next
-    sampling, like the reference's partition). Returns a list of row dicts.
-    """
+def create_pairs_from_document(all_documents, document_index, config, g):
+    """NSP pair instances (unmasked) from one document: list of
+    (a_ids, b_ids, is_random_next)."""
     document = all_documents[document_index]
     max_num_tokens = config.max_seq_length - 3
     target_seq_length = max_num_tokens
@@ -173,7 +167,7 @@ def create_pairs_from_document(all_documents, document_index, config, g,
                     target_b_length = target_seq_length - len(tokens_a)
                     # Pick a different document (bounded retries mirror the
                     # standard algorithm; degenerate single-doc blocks fall
-                    # back to self, which truncation keeps well-formed).
+                    # back to self, kept well-formed by truncation).
                     random_document_index = document_index
                     if len(all_documents) > 1:
                         for _ in range(10):
@@ -197,54 +191,222 @@ def create_pairs_from_document(all_documents, document_index, config, g,
 
                 _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, g)
                 if len(tokens_a) >= 1 and len(tokens_b) >= 1:
-                    row = _make_row(tokens_a, tokens_b, is_random_next,
-                                    config, g, vocab_words)
-                    instances.append(row)
+                    instances.append((tokens_a, tokens_b, is_random_next))
             current_chunk = []
             current_length = 0
         i += 1
     return instances
 
 
-def _make_row(tokens_a, tokens_b, is_random_next, config, g, vocab_words):
-    if config.masking:
-        if not vocab_words:
-            raise ValueError("masking requires vocab_words")
-        tokens = ["[CLS]"] + tokens_a + ["[SEP]"] + tokens_b + ["[SEP]"]
-        positions, labels = create_masked_lm_predictions(
-            tokens, vocab_words, g, config.masked_lm_ratio,
-            config.max_predictions_per_seq, config.whole_word_masking)
-        # Read the (possibly masked) A/B back out of the full sequence.
-        tokens_a = tokens[1:1 + len(tokens_a)]
-        tokens_b = tokens[2 + len(tokens_a):-1]
-        row = {
-            "A": " ".join(tokens_a),
-            "B": " ".join(tokens_b),
-            "is_random_next": bool(is_random_next),
-            "num_tokens": len(tokens_a) + len(tokens_b) + 3,
-            "masked_lm_positions": serialize_np_array(
-                np.asarray(positions, dtype=np.uint16)),
-            "masked_lm_labels": " ".join(labels),
-        }
-    else:
-        row = {
-            "A": " ".join(tokens_a),
-            "B": " ".join(tokens_b),
-            "is_random_next": bool(is_random_next),
-            "num_tokens": len(tokens_a) + len(tokens_b) + 3,
-        }
-    return row
-
-
-def pairs_from_documents(documents, config, g, vocab_words=None):
-    """All pair instances for a block: ``duplicate_factor`` passes over every
-    document (each pass draws fresh randomness -> different pairs/masks,
-    ref pretrain.py:386-402), shuffled within the block."""
-    rows = []
+def pairs_from_documents(documents, config, g):
+    """All (a_ids, b_ids, is_random_next) instances for a block:
+    ``duplicate_factor`` passes, shuffled within the block."""
+    instances = []
     for _ in range(config.duplicate_factor):
         for doc_idx in range(len(documents)):
-            rows.extend(
-                create_pairs_from_document(documents, doc_idx, config, g,
-                                           vocab_words=vocab_words))
-    lrng.shuffle(g, rows)
+            instances.extend(
+                create_pairs_from_document(documents, doc_idx, config, g))
+    lrng.shuffle(g, instances)
+    return instances
+
+
+def _build_sequences(instances, tok_info):
+    """[CLS] a [SEP] b [SEP] id lists + per-row A lengths."""
+    seqs = []
+    a_lens = np.empty(len(instances), dtype=np.int32)
+    for i, (a, b, _) in enumerate(instances):
+        seqs.append([tok_info.cls_id] + a + [tok_info.sep_id] + b
+                    + [tok_info.sep_id])
+        a_lens[i] = len(a)
+    return seqs, a_lens
+
+
+def _candidate_mask(valid, a_lens, seq_lens):
+    """Positions eligible for masking: valid, not [CLS]/[SEP]."""
+    candidate = valid.copy()
+    rows = np.arange(valid.shape[0])
+    candidate[:, 0] = False
+    candidate[rows, a_lens + 1] = False
+    candidate[rows, seq_lens - 1] = False
+    return candidate
+
+
+def apply_static_masking(instances, config, tok_info, seed, scope):
+    """Batch-mask all instances of a bucket; returns per-row
+    (masked_seq_ids, positions, label_ids).
+
+    Engine "numpy": vectorized host kernel on a Philox stream.
+    Engine "jax": jit'd kernel (TPU when available), padded to lane-aligned
+    buckets so compilations stay bounded.
+    """
+    seqs, a_lens = _build_sequences(instances, tok_info)
+    seq_lens = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    width = min(128, config.max_seq_length)
+    ids, valid = pad_to_bucket(seqs, pad_id=tok_info.pad_id,
+                               length_multiple=width, min_length=width)
+    candidate = _candidate_mask(valid, a_lens, seq_lens)
+    num_to_predict = plan_num_to_predict(seq_lens, config.masked_lm_ratio,
+                                         config.max_predictions_per_seq)
+
+    if config.whole_word_masking:
+        masked, selected = _mask_whole_word(ids, candidate, num_to_predict,
+                                            tok_info,
+                                            lrng.sample_rng(seed, *scope))
+    elif config.engine == "jax":
+        masker = _get_jax_masker(tok_info)
+        # Pad the batch dim to a bucket as well: jit keys compilations on
+        # the full shape, and every bucket has a different row count.
+        n = ids.shape[0]
+        n_pad = max(512, 1 << (n - 1).bit_length())
+        if n_pad > n:
+            pad_rows = n_pad - n
+            ids_p = np.pad(ids, ((0, pad_rows), (0, 0)))
+            cand_p = np.pad(candidate, ((0, pad_rows), (0, 0)))
+            num_p = np.pad(num_to_predict, (0, pad_rows))
+        else:
+            ids_p, cand_p, num_p = ids, candidate, num_to_predict
+        # Fold the scope into a 32-bit seed for jax.random.
+        import hashlib
+        h = hashlib.blake2b(
+            ("{}:{}".format(seed, scope)).encode(), digest_size=4).digest()
+        masked, selected = masker(ids_p, cand_p, num_p,
+                                  int.from_bytes(h, "little"))
+        masked, selected = masked[:n], selected[:n]
+    else:
+        masked, selected = mask_batch_numpy(
+            ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),
+            tok_info.mask_id, tok_info.vocab_size)
+
+    out = []
+    for i in range(len(seqs)):
+        positions = np.nonzero(selected[i])[0].astype(np.uint16)
+        labels = ids[i, positions]
+        out.append((masked[i], positions, labels))
+    return out, a_lens, seq_lens
+
+
+_JAX_MASKERS = {}
+
+
+def _get_jax_masker(tok_info):
+    key = (tok_info.mask_id, tok_info.vocab_size)
+    if key not in _JAX_MASKERS:
+        _JAX_MASKERS[key] = make_jax_masker(tok_info.mask_id,
+                                            tok_info.vocab_size)
+    return _JAX_MASKERS[key]
+
+
+def _mask_whole_word(ids, candidate, num_to_predict, tok_info, g):
+    """Whole-word masking: subword continuations group with their word
+    start; groups are selected atomically. Per-row loop (rarely used)."""
+    out = ids.copy()
+    selected = np.zeros_like(candidate)
+    is_sub = tok_info.is_subword
+    for r in range(ids.shape[0]):
+        cols = np.nonzero(candidate[r])[0]
+        groups = []
+        for c in cols:
+            if groups and is_sub[ids[r, c]] and groups[-1][-1] == c - 1:
+                groups[-1].append(c)
+            else:
+                groups.append([c])
+        order = g.permutation(len(groups))
+        budget = int(num_to_predict[r])
+        taken = 0
+        for gi in order:
+            group = groups[gi]
+            if taken >= budget:
+                break
+            if taken + len(group) > budget:
+                continue
+            for c in group:
+                r_act = g.random()
+                if r_act < 0.8:
+                    out[r, c] = tok_info.mask_id
+                elif r_act < 0.9:
+                    out[r, c] = int(g.integers(0, tok_info.vocab_size))
+                selected[r, c] = True
+                taken += 1
+    return out, selected
+
+
+def materialize_rows(instances, config, tok_info, seed, scope):
+    """Instances -> parquet row dicts (strings), applying static masking
+    batch-wise when configured."""
+    if not config.masking:
+        return [{
+            "A": tok_info.join(a),
+            "B": tok_info.join(b),
+            "is_random_next": bool(rn),
+            "num_tokens": len(a) + len(b) + 3,
+        } for a, b, rn in instances]
+
+    masked_rows, a_lens, seq_lens = apply_static_masking(
+        instances, config, tok_info, seed, scope)
+    rows = []
+    for i, (inst, (masked_seq, positions, label_ids)) in enumerate(
+            zip(instances, masked_rows)):
+        la = int(a_lens[i])
+        end = int(seq_lens[i])
+        rows.append({
+            "A": tok_info.join(masked_seq[1:1 + la]),
+            "B": tok_info.join(masked_seq[2 + la:end - 1]),
+            "is_random_next": bool(inst[2]),
+            "num_tokens": end,
+            "masked_lm_positions": serialize_np_array(
+                positions.astype(np.uint16)),
+            "masked_lm_labels": tok_info.join(label_ids),
+        })
     return rows
+
+
+# Backwards-compatible helper used by tests and docs: per-sequence masking
+# on token strings via the batch kernel.
+def create_masked_lm_predictions(tokens, vocab_words, g, masked_lm_ratio,
+                                 max_predictions_per_seq,
+                                 whole_word_masking=False):
+    """Mask one token-string sequence in place; returns (positions, labels).
+
+    Thin per-row wrapper over the batch kernels, kept for API parity with
+    the reference's function of the same name (pretrain.py:182-238).
+    """
+    token_to_id = {t: i for i, t in enumerate(vocab_words)}
+    # Specials (and any out-of-population token such as [UNK]) get reserved
+    # ids beyond the random-draw range so they are never fabricated.
+    extra = {}
+
+    def id_of(t):
+        if t in token_to_id:
+            return token_to_id[t]
+        if t not in extra:
+            extra[t] = len(vocab_words) + len(extra)
+        return extra[t]
+
+    mask_reserved = id_of("[MASK]")
+    ids = np.array([[id_of(t) for t in tokens]], dtype=np.int32)
+    candidate = np.array(
+        [[t not in ("[CLS]", "[SEP]") for t in tokens]], dtype=bool)
+    num = plan_num_to_predict([len(tokens)], masked_lm_ratio,
+                              max_predictions_per_seq)
+    if whole_word_masking:
+        class _Shim:
+            pass
+        shim = _Shim()
+        shim.mask_id = mask_reserved
+        shim.vocab_size = len(vocab_words)
+        shim.is_subword = np.array(
+            [t.startswith("##") for t in vocab_words]
+            + [False] * len(extra), dtype=bool)
+        masked, selected = _mask_whole_word(ids, candidate, num, shim, g)
+    else:
+        masked, selected = mask_batch_numpy(ids, candidate, num, g,
+                                            mask_reserved, len(vocab_words))
+    positions = np.nonzero(selected[0])[0]
+    labels = [tokens[p] for p in positions]
+    id_to_tok = {i: t for t, i in token_to_id.items()}
+    id_to_tok.update({v: k for k, v in extra.items()})
+    for p in positions:
+        new_id = int(masked[0, p])
+        if new_id != int(ids[0, p]):  # keep path: leave original verbatim
+            tokens[p] = id_to_tok[new_id]
+    return positions.tolist(), labels

@@ -32,7 +32,13 @@ import time
 
 from ..parallel.distributed import LocalCommunicator
 from ..utils import rng as lrng
-from .bert import BertPretrainConfig, documents_from_texts, pairs_from_documents
+from .bert import (
+    BertPretrainConfig,
+    TokenizerInfo,
+    documents_from_texts,
+    materialize_rows,
+    pairs_from_documents,
+)
 from .readers import discover_source_files, plan_blocks, read_documents
 from . import binning as binning_mod
 
@@ -47,9 +53,8 @@ def _bucket_of(seed, block_id, doc_ordinal, nbuckets):
 
 
 def vocab_words_of(tokenizer):
-    """Vocab tokens ordered by id, specials excluded — the population for
-    random-replacement masking. Ordering by id keeps masking deterministic
-    across hosts/python versions."""
+    """Vocab tokens ordered by id, specials excluded. Kept for the
+    per-sequence masking helper; the pipeline itself uses TokenizerInfo."""
     specials = set(tokenizer.all_special_tokens)
     vocab = tokenizer.get_vocab()
     return [t for t, _ in sorted(vocab.items(), key=lambda kv: kv[1])
@@ -99,12 +104,14 @@ def _read_bucket_docs(out_dir, bucket):
     return texts
 
 
-def _process_bucket(texts, bucket, tokenizer, config, vocab_words, seed,
-                    out_dir, bin_size, output_format):
+def _process_bucket(texts, bucket, tok_info, config, seed, out_dir, bin_size,
+                    output_format):
     g = lrng.sample_rng(seed, 0x9A1A, bucket)
     lrng.shuffle(g, texts)
-    documents = documents_from_texts(texts, tokenizer)
-    rows = pairs_from_documents(documents, config, g, vocab_words=vocab_words)
+    documents = documents_from_texts(texts, tok_info.tokenizer)
+    instances = pairs_from_documents(documents, config, g)
+    rows = materialize_rows(instances, config, tok_info, seed,
+                            (0x3A5C, bucket))
     if output_format == "txt":
         return _write_txt_shard(rows, out_dir, bucket, config.masking,
                                 bin_size, config.max_seq_length)
@@ -205,7 +212,7 @@ def run_bert_preprocess(
     nbuckets = len(blocks)
     log("{} input files -> {} blocks".format(len(input_files), len(blocks)))
 
-    vocab_words = vocab_words_of(tokenizer) if config.masking else None
+    tok_info = TokenizerInfo(tokenizer)
 
     if global_shuffle:
         _scatter_phase(blocks, out_dir, comm, sample_ratio, seed, nbuckets, log)
@@ -221,8 +228,8 @@ def run_bert_preprocess(
                     blocks[bucket], sample_ratio=sample_ratio, base_seed=seed)
             ]
         written.update(
-            _process_bucket(texts, bucket, tokenizer, config, vocab_words,
-                            seed, out_dir, bin_size, output_format))
+            _process_bucket(texts, bucket, tok_info, config, seed, out_dir,
+                            bin_size, output_format))
     comm.barrier()
 
     if global_shuffle and comm.rank == 0:

@@ -32,6 +32,11 @@ _BOUNDARY = re.compile(
 
 
 def _use_nltk():
+    # Opt-in only: merely importing nltk costs seconds of startup, so the
+    # punkt path must be requested explicitly.
+    import os
+    if os.environ.get("LDDL_TPU_SENTENCE_SPLITTER", "") != "nltk":
+        return False
     try:
         import nltk.data
         nltk.data.find("tokenizers/punkt")

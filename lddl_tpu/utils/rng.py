@@ -77,14 +77,16 @@ def sample_rng(base_seed, *scope):
 
 
 def shuffle(rng, seq):
-    """In-place Fisher-Yates shuffle of a list using ``rng``.
+    """In-place shuffle of a list using ``rng``.
 
-    We implement it explicitly (rather than ``rng.shuffle``) so the consumed
-    random stream is independent of numpy version details for golden tests.
+    Vectorized (C-speed) yet version-stable: the permutation is the stable
+    argsort of one batch of raw uniform draws. Philox's raw double stream
+    is bit-stable across numpy releases, unlike ``Generator.permutation``
+    internals (NEP 19), so shard contents stay reproducible across
+    environments. Stream contract: one ``random(len(seq))`` draw per call.
     """
-    for i in range(len(seq) - 1, 0, -1):
-        j = int(rng.integers(0, i + 1))
-        seq[i], seq[j] = seq[j], seq[i]
+    perm = np.argsort(rng.random(len(seq)), kind="stable")
+    seq[:] = [seq[i] for i in perm]
     return seq
 
 

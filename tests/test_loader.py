@@ -160,13 +160,15 @@ def test_dp_group_sharding(pipeline):
     sf = [s[0] + "|" + s[1] for batch in full for s in batch]
     assert sa and sc
     assert len(sa) == len(sc) == len(sf) // 2
-    # Which sample gets dropped at the truncation boundary may differ
-    # between layouts; everything else must match exactly.
+    # Which samples get dropped at the truncation boundary may differ
+    # between layouts: with balanced counts base/base+1, up to
+    # (num_files - 1) extras exist, and each side of the comparison can
+    # drop a different one -> at most 2*(num_files-1) mismatched entries.
     import collections
     ca = collections.Counter(sa + sc)
     cf = collections.Counter(sf)
     mismatch = sum(((ca - cf) + (cf - ca)).values())
-    assert mismatch <= 2
+    assert mismatch <= 2 * (4 - 1)
 
 
 def test_binned_loader_sync_and_shapes(pipeline):

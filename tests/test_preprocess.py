@@ -16,7 +16,13 @@ from lddl_tpu.preprocess import (
     run_bert_preprocess,
     split_sentences,
 )
-from lddl_tpu.preprocess.bert import documents_from_texts, pairs_from_documents
+from lddl_tpu.preprocess.bert import (TokenizerInfo, documents_from_texts,
+                                       materialize_rows, pairs_from_documents)
+
+
+def _rows(documents, config, tok, g, scope=(1, 2)):
+    instances = pairs_from_documents(documents, config, g)
+    return materialize_rows(instances, config, TokenizerInfo(tok), 0, scope)
 from lddl_tpu.preprocess.readers import plan_blocks, read_block_lines
 from lddl_tpu.preprocess.runner import vocab_words_of
 from lddl_tpu.utils import rng as lrng
@@ -84,7 +90,7 @@ def test_documents_from_texts(tokenizer):
         tokenizer)
     assert len(docs) == 2
     assert len(docs[0]) == 2  # two sentences
-    assert all(isinstance(t, str) for t in docs[0][0])
+    assert all(isinstance(t, int) for t in docs[0][0])  # token ids
 
 
 def test_pair_creation_invariants(tokenizer):
@@ -97,7 +103,7 @@ def test_pair_creation_invariants(tokenizer):
     documents = documents_from_texts(texts, tokenizer)
     config = BertPretrainConfig(max_seq_length=32, duplicate_factor=2)
     g = lrng.sample_rng(0, 1)
-    rows = pairs_from_documents(documents, config, g)
+    rows = _rows(documents, config, tokenizer, g)
     assert len(rows) > 0
     saw_random, saw_next = False, False
     for r in rows:
@@ -115,10 +121,10 @@ def test_pair_creation_deterministic(tokenizer):
     texts = ["Alpha beta gamma delta. Epsilon zeta eta theta. Iota kappa."] * 4
     documents = documents_from_texts(texts, tokenizer)
     config = BertPretrainConfig(max_seq_length=24)
-    r1 = pairs_from_documents(documents, config, lrng.sample_rng(9, 2))
-    r2 = pairs_from_documents(documents, config, lrng.sample_rng(9, 2))
+    r1 = _rows(documents, config, tokenizer, lrng.sample_rng(9, 2))
+    r2 = _rows(documents, config, tokenizer, lrng.sample_rng(9, 2))
     assert r1 == r2
-    r3 = pairs_from_documents(documents, config, lrng.sample_rng(9, 3))
+    r3 = _rows(documents, config, tokenizer, lrng.sample_rng(9, 3))
     assert r1 != r3  # different stream -> different pairs (w.h.p.)
 
 
