@@ -1,0 +1,215 @@
+"""Mock training loop: loader perf harness + correctness probe.
+
+Reference parity: benchmarks/torch_train.py — throughput/latency meters,
+per-iteration seq-len and padded-zero stats, batch-shape asserts, --debug
+raw-sample inspection with de-masking round-trip, per-rank .npz dumps for
+offline validation (benchmarks/validate_seqlen.py). Plus what the
+reference could not do: ``--with-model`` runs a real jitted BERT train
+step on a device mesh, measuring end-to-end step time instead of loader
+time alone.
+
+Single-process simulation of a multi-rank layout: pass --dp-rank/
+--num-dp-groups (runs this rank's loader exactly as it would run in the
+full job).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+# Allow running by path from anywhere: put the repo root on sys.path.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+class AverageMeter:
+
+    def __init__(self, warmup=2, keep=False):
+        self.warmup = warmup
+        self.keep = keep
+        self.reset()
+
+    def reset(self):
+        self.val = 0
+        self.avg = 0
+        self.max = float("-inf")
+        self.min = float("inf")
+        self.sum = 0
+        self.count = 0
+        self.iters = 0
+        self.vals = []
+
+    def update(self, val, n=1):
+        self.iters += 1
+        self.val = val
+        if self.iters > self.warmup:
+            self.sum += val * n
+            self.max = max(val, self.max)
+            self.min = min(val, self.min)
+            self.count += n
+            self.avg = self.sum / self.count
+            if self.keep:
+                self.vals.append(val)
+
+
+class Histogram:
+
+    def __init__(self):
+        self.counts = {}
+
+    def update(self, key, n=1):
+        self.counts[key] = self.counts.get(key, 0) + n
+
+
+def attach_args():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--path", required=True, help="balanced shard dir")
+    p.add_argument("--vocab-file", required=True)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--start-epoch", type=int, default=0)
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--log-freq", type=int, default=100)
+    p.add_argument("--seed", type=int, default=12345)
+    p.add_argument("--dp-rank", type=int, default=0)
+    p.add_argument("--num-dp-groups", type=int, default=1)
+    p.add_argument("--fixed-seq-lengths", type=int, nargs="*", default=None)
+    p.add_argument("--seq-len-dir", default=None,
+                   help="dump lens_<dp_rank>.npz here for validate_seqlen.py")
+    p.add_argument("--debug", action="store_true")
+    p.add_argument("--with-model", choices=("tiny", "base"), default=None,
+                   help="run a real jitted train step per batch")
+    p.add_argument("--mesh", default=None,
+                   help="axes for --with-model, e.g. dp=2,tp=2,sp=2 "
+                        "(default: all devices on dp)")
+    return p
+
+
+def _debug_print(loader, tokenizer):
+    from lddl_tpu.utils.fs import deserialize_np_array
+    for i, batch in enumerate(loader):
+        for sample in batch[:2]:
+            if len(sample) == 5:
+                a, b, rn, pos_b, labels = sample
+                seq = (["[CLS]"] + a.split() + ["[SEP]"] + b.split()
+                       + ["[SEP]"])
+                pos = deserialize_np_array(pos_b).tolist()
+                labs = labels.split()
+                print("is_random_next:", rn)
+                print("masked:", " ".join(seq))
+                for p, l in zip(pos, labs):
+                    seq[p] = l
+                print("demasked:", " ".join(seq))
+            else:
+                print("is_random_next:", sample[2])
+                print("[CLS] {} [SEP] {} [SEP]".format(sample[0], sample[1]))
+        if i >= 2:
+            return
+
+
+def main():
+    args = attach_args().parse_args()
+    from lddl_tpu.loader import get_bert_pretrain_data_loader, to_device_batch
+
+    loader = get_bert_pretrain_data_loader(
+        args.path,
+        dp_rank=args.dp_rank,
+        num_dp_groups=args.num_dp_groups,
+        batch_size=args.batch_size,
+        num_workers=args.num_workers,
+        vocab_file=args.vocab_file,
+        fixed_seq_lengths=args.fixed_seq_lengths,
+        base_seed=args.seed,
+        start_epoch=args.start_epoch,
+        return_raw_samples=args.debug,
+    )
+    if args.debug:
+        from lddl_tpu.preprocess import get_tokenizer
+        _debug_print(loader, get_tokenizer(vocab_file=args.vocab_file))
+        return
+
+    step = None
+    mesh = None
+    if args.with_model:
+        import jax
+        from lddl_tpu.models import (BertConfig, create_train_state,
+                                     make_sharded_train_step)
+        from lddl_tpu.parallel import make_mesh
+        axes = {"dp": -1}
+        if args.mesh:
+            axes = {k: int(v) for k, v in
+                    (kv.split("=") for kv in args.mesh.split(","))}
+        mesh = make_mesh(axes)
+        cfg = (BertConfig.tiny() if args.with_model == "tiny"
+               else BertConfig.bert_base())
+        sample = next(iter(loader))
+        state, _ = create_train_state(cfg, mesh, sample)
+        step_fn = make_sharded_train_step(mesh, cfg)
+
+        def step(batch):
+            nonlocal state
+            state, metrics = step_fn(state, to_device_batch(batch, mesh),
+                                     seed=args.seed)
+            return metrics
+
+    batch_time = AverageMeter(warmup=2)
+    throughput = AverageMeter(warmup=2)
+    seq_len_hist = Histogram()
+    pad_hist = Histogram()
+    all_min_lens, all_max_lens, all_batch_lens = [], [], []
+    step_time = AverageMeter(warmup=2)
+
+    for epoch in range(args.start_epoch, args.start_epoch + args.epochs):
+        t0 = time.perf_counter()
+        for i, batch in enumerate(loader):
+            n, L = batch["input_ids"].shape
+            # Shape contracts (ref torch_train.py:171-175).
+            assert batch["token_type_ids"].shape == (n, L)
+            assert batch["attention_mask"].shape == (n, L)
+            assert batch["labels"].shape == (n, L)
+            assert batch["next_sentence_labels"].shape == (n,)
+            lens = batch["attention_mask"].sum(axis=1)
+            seq_len_hist.update(L, n)
+            pad_hist.update(L, int((L - lens).sum()))
+            all_min_lens.append(int(lens.min()))
+            all_max_lens.append(int(lens.max()))
+            all_batch_lens.append(L)
+            if step is not None:
+                ts = time.perf_counter()
+                metrics = step(batch)
+                float(metrics["loss"])  # sync
+                step_time.update(time.perf_counter() - ts)
+            dt = time.perf_counter() - t0
+            batch_time.update(dt)
+            throughput.update(n / dt)
+            if (i + 1) % args.log_freq == 0:
+                print("epoch {} it {}: {:.1f} samples/s, {:.2f} ms/batch"
+                      .format(epoch, i + 1, throughput.avg,
+                              batch_time.avg * 1e3))
+            t0 = time.perf_counter()
+
+    total_tokens = sum(k * v for k, v in seq_len_hist.counts.items())
+    total_pad = sum(pad_hist.counts.values())
+    print("loader throughput: {:.1f} samples/s avg, {:.2f} ms/batch avg"
+          .format(throughput.avg, batch_time.avg * 1e3))
+    if step is not None:
+        print("train step: {:.2f} ms avg on mesh {}".format(
+            step_time.avg * 1e3, dict(mesh.shape)))
+    print("padded-zero ratio: {:.4f} ({} pad / {} slots)".format(
+        total_pad / max(total_tokens, 1), total_pad, total_tokens))
+    if args.seq_len_dir:
+        os.makedirs(args.seq_len_dir, exist_ok=True)
+        np.savez(
+            os.path.join(args.seq_len_dir,
+                         "lens_{}.npz".format(args.dp_rank)),
+            min_lens=np.asarray(all_min_lens),
+            max_lens=np.asarray(all_max_lens),
+            batch_lens=np.asarray(all_batch_lens),
+        )
+        print("wrote {}/lens_{}.npz".format(args.seq_len_dir, args.dp_rank))
+
+
+if __name__ == "__main__":
+    main()

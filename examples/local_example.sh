@@ -1,0 +1,71 @@
+#!/bin/bash
+# End-to-end single-host recipe (reference parity: examples/local_example.sh).
+# Zero-egress friendly: uses a synthetic Wikipedia-like corpus; swap step 1
+# for `download_wikipedia --outdir $DATA/wiki` on a connected machine.
+set -euo pipefail
+
+DATA=${DATA:-/tmp/lddl_tpu_example}
+SEQ_LEN=${SEQ_LEN:-128}
+BIN_SIZE=${BIN_SIZE:-32}
+NUM_SHARDS=${NUM_SHARDS:-8}
+cd "$(dirname "$0")/.."
+
+rm -rf "$DATA"
+mkdir -p "$DATA"
+
+echo "== 1. corpus (synthetic; see download_wikipedia for the real one) =="
+python - "$DATA" <<'EOF'
+import sys, bench
+tmp, n = bench.make_corpus(target_mb=4, shards=4)
+import shutil, os
+shutil.move(os.path.join(tmp, "corpus"), os.path.join(sys.argv[1], "wiki"))
+print("corpus bytes:", n)
+EOF
+
+echo "== 2. vocab =="
+python - "$DATA" <<'EOF'
+import sys, glob
+from lddl_tpu.preprocess import build_wordpiece_vocab
+texts = []
+for p in glob.glob(sys.argv[1] + "/wiki/source/*.txt"):
+    with open(p) as f:
+        for i, line in enumerate(f):
+            texts.append(line.split(None, 1)[1])
+            if i > 500: break
+build_wordpiece_vocab(texts, sys.argv[1] + "/vocab.txt", vocab_size=8192)
+EOF
+
+echo "== 3. preprocess (binned, static masking) =="
+python -m lddl_tpu.cli.preprocess_bert_pretrain \
+  --wikipedia "$DATA/wiki" \
+  --sink "$DATA/pre" \
+  --vocab-file "$DATA/vocab.txt" \
+  --target-seq-length "$SEQ_LEN" \
+  --bin-size "$BIN_SIZE" \
+  --masking \
+  --duplicate-factor 2 \
+  --sample-ratio 1.0 \
+  --num-blocks 8
+
+echo "== 4. balance =="
+python -m lddl_tpu.cli.balance_shards \
+  --indir "$DATA/pre" --outdir "$DATA/bal" --num-shards "$NUM_SHARDS"
+
+echo "== 5. mock training (2 simulated dp groups) =="
+for RANK in 0 1; do
+  python benchmarks/mock_train.py \
+    --path "$DATA/bal" \
+    --vocab-file "$DATA/vocab.txt" \
+    --batch-size 32 \
+    --epochs 1 \
+    --log-freq 20 \
+    --dp-rank "$RANK" --num-dp-groups 2 \
+    --fixed-seq-lengths 32 64 96 128 \
+    --seq-len-dir "$DATA/seqlens"
+done
+
+echo "== 6. validate binning + sync =="
+python benchmarks/validate_seqlen.py \
+  --seq-len-dir "$DATA/seqlens" --bin-size "$BIN_SIZE"
+
+echo "example complete: $DATA"

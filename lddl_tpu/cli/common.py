@@ -1,0 +1,48 @@
+"""Shared CLI plumbing: corpus path flags, multihost init, logging."""
+
+import argparse
+
+
+def attach_corpus_args(parser):
+    parser.add_argument("--wikipedia", default=None,
+                        help="path to the wikipedia corpus (dir with "
+                             "source/*.txt)")
+    parser.add_argument("--books", default=None)
+    parser.add_argument("--common-crawl", default=None)
+    parser.add_argument("--open-webtext", default=None)
+
+
+def corpus_paths_of(args):
+    paths = {
+        "wikipedia": args.wikipedia,
+        "books": args.books,
+        "common_crawl": args.common_crawl,
+        "open_webtext": args.open_webtext,
+    }
+    if all(v is None for v in paths.values()):
+        raise SystemExit(
+            "give at least one corpus: --wikipedia/--books/--common-crawl/"
+            "--open-webtext")
+    return paths
+
+
+def attach_multihost_arg(parser):
+    parser.add_argument(
+        "--multihost", action="store_true",
+        help="initialize jax.distributed (reads the standard "
+             "JAX coordinator env vars / TPU metadata) and split work "
+             "across hosts")
+
+
+def communicator_of(args):
+    from ..parallel.distributed import get_communicator
+    if getattr(args, "multihost", False):
+        import jax
+        jax.distributed.initialize()
+    return get_communicator()
+
+
+def make_parser(description):
+    return argparse.ArgumentParser(
+        description=description,
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)

@@ -1,0 +1,50 @@
+"""CLI: BART pretraining preprocessor.
+
+Reference parity: the ``preprocess_bart_pretrain`` console script
+(lddl/dask/bart/pretrain.py:155-290).
+"""
+
+from ..preprocess import BartPretrainConfig, run_bart_preprocess
+from ..utils.args import attach_bool_arg
+from .common import (attach_corpus_args, attach_multihost_arg,
+                     communicator_of, corpus_paths_of, make_parser)
+
+
+def attach_args(parser=None):
+    parser = parser or make_parser(__doc__)
+    attach_corpus_args(parser)
+    attach_multihost_arg(parser)
+    parser.add_argument("--sink", "--outdir", dest="sink", required=True)
+    parser.add_argument("--target-seq-length", type=int, default=128)
+    parser.add_argument("--short-seq-prob", type=float, default=0.1)
+    parser.add_argument("--sample-ratio", type=float, default=0.9)
+    parser.add_argument("--seed", type=int, default=12345)
+    parser.add_argument("--num-blocks", type=int, default=64)
+    parser.add_argument("--output-format", choices=("parquet", "txt"),
+                        default="parquet")
+    attach_bool_arg(parser, "global-shuffle", default=True)
+    return parser
+
+
+def main(args=None):
+    args = args if args is not None else attach_args().parse_args()
+    comm = communicator_of(args)
+    run_bart_preprocess(
+        corpus_paths_of(args),
+        args.sink,
+        config=BartPretrainConfig(
+            target_seq_length=args.target_seq_length,
+            short_seq_prob=args.short_seq_prob,
+        ),
+        num_blocks=args.num_blocks,
+        sample_ratio=args.sample_ratio,
+        seed=args.seed,
+        global_shuffle=args.global_shuffle,
+        output_format=args.output_format,
+        comm=comm,
+        log=print,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""CLI: BERT pretraining preprocessor.
+
+Reference parity: the ``preprocess_bert_pretrain`` console script
+(lddl/dask/bert/pretrain.py:618-883), with dask/mpi flags replaced by
+the static-scheduled runner's (--num-blocks, --multihost) and the new
+--engine flag selecting the masking kernel backend.
+"""
+
+from ..preprocess import BertPretrainConfig, get_tokenizer, run_bert_preprocess
+from ..utils.args import attach_bool_arg
+from .common import (attach_corpus_args, attach_multihost_arg,
+                     communicator_of, corpus_paths_of, make_parser)
+
+
+def attach_args(parser=None):
+    parser = parser or make_parser(__doc__)
+    attach_corpus_args(parser)
+    attach_multihost_arg(parser)
+    parser.add_argument("--sink", "--outdir", dest="sink", required=True,
+                        help="output directory for the parquet shards")
+    parser.add_argument("--vocab-file", default=None)
+    parser.add_argument("--tokenizer", default=None,
+                        help="HF hub tokenizer name (alternative to "
+                             "--vocab-file)")
+    parser.add_argument("--target-seq-length", type=int, default=128)
+    parser.add_argument("--short-seq-prob", type=float, default=0.1)
+    attach_bool_arg(parser, "masking", default=False,
+                    help_str="static masking (default: dynamic at load time)")
+    parser.add_argument("--masked-lm-ratio", type=float, default=0.15)
+    parser.add_argument("--max-predictions-per-seq", type=int, default=None)
+    attach_bool_arg(parser, "whole-word-masking", default=False)
+    parser.add_argument("--duplicate-factor", type=int, default=5)
+    parser.add_argument("--sample-ratio", type=float, default=0.9)
+    parser.add_argument("--seed", type=int, default=12345)
+    parser.add_argument("--bin-size", type=int, default=None)
+    parser.add_argument("--num-blocks", type=int, default=64)
+    parser.add_argument("--engine", choices=("numpy", "jax"), default="numpy",
+                        help="masking kernel backend (jax = jit on TPU)")
+    parser.add_argument("--output-format", choices=("parquet", "txt"),
+                        default="parquet")
+    attach_bool_arg(parser, "global-shuffle", default=True,
+                    help_str="two-pass global document shuffle")
+    return parser
+
+
+def main(args=None):
+    args = args if args is not None else attach_args().parse_args()
+    if args.vocab_file is None and args.tokenizer is None:
+        raise SystemExit("need --vocab-file or --tokenizer")
+    comm = communicator_of(args)
+    tokenizer = get_tokenizer(vocab_file=args.vocab_file,
+                              pretrained_model_name=args.tokenizer)
+    config = BertPretrainConfig(
+        max_seq_length=args.target_seq_length,
+        short_seq_prob=args.short_seq_prob,
+        masking=args.masking,
+        masked_lm_ratio=args.masked_lm_ratio,
+        max_predictions_per_seq=args.max_predictions_per_seq,
+        whole_word_masking=args.whole_word_masking,
+        duplicate_factor=args.duplicate_factor,
+        engine=args.engine,
+    )
+    run_bert_preprocess(
+        corpus_paths_of(args),
+        args.sink,
+        tokenizer,
+        config=config,
+        num_blocks=args.num_blocks,
+        sample_ratio=args.sample_ratio,
+        seed=args.seed,
+        bin_size=args.bin_size,
+        global_shuffle=args.global_shuffle,
+        output_format=args.output_format,
+        comm=comm,
+        log=print,
+    )
+
+
+if __name__ == "__main__":
+    main()

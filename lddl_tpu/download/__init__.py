@@ -1,0 +1,3 @@
+from .utils import download, shard_documents
+
+__all__ = ["download", "shard_documents"]

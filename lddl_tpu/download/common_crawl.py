@@ -1,0 +1,156 @@
+"""Common Crawl news downloader.
+
+Reference parity: lddl/download/common_crawl.py, which wraps
+``news-please``'s commoncrawl crawler with language/date filters, streams
+articles into per-(pid, tid) buffer files flushed every
+``--articles-per-write``, and finally aggregates+shards. We keep the same
+architecture with the crawler gated behind the optional ``news-please``
+package, and support the same resumable multi-node prefix naming so
+several hosts can download concurrently into one directory and shard once
+at the end (ref: common_crawl.py:114-122,336-344).
+"""
+
+import argparse
+import os
+import threading
+import time
+
+from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
+from .utils import _ShardWriter
+
+
+class ArticleBuffer:
+    """Thread-local article buffering with periodic flush, mirroring the
+    reference's streaming callback design (common_crawl.py:310-381)."""
+
+    def __init__(self, txt_dir, prefix, articles_per_write=1000):
+        self._txt_dir = txt_dir
+        self._prefix = prefix
+        self._articles_per_write = articles_per_write
+        self._local = threading.local()
+        os.makedirs(txt_dir, exist_ok=True)
+
+    def _state(self):
+        if not hasattr(self._local, "articles"):
+            self._local.articles = []
+            self._local.warc_count = 0
+        return self._local
+
+    def add(self, doc_id, text):
+        state = self._state()
+        state.articles.append((doc_id, text))
+        if len(state.articles) >= self._articles_per_write:
+            self.flush()
+
+    def flush(self):
+        state = self._state()
+        if not state.articles:
+            return
+        # Unique name per (prefix, pid, tid, counter, time) so many hosts /
+        # threads never collide on a shared filesystem.
+        name = "{}-{}-{}-{}-{}.txt".format(
+            self._prefix, os.getpid(), threading.get_ident(),
+            state.warc_count, time.time_ns())
+        with open(os.path.join(self._txt_dir, name), "w",
+                  encoding="utf-8") as f:
+            for doc_id, text in state.articles:
+                f.write(doc_id + " " + " ".join(text.split()) + "\n")
+        state.articles = []
+        state.warc_count += 1
+
+
+def crawl(outdir, prefix, start_date=None, end_date=None, language="en",
+          articles_per_write=1000, continue_process=True):
+    try:
+        from newsplease.crawler import commoncrawl_crawler
+    except ImportError as e:
+        raise RuntimeError(
+            "the 'news-please' package is required to crawl Common Crawl "
+            "(pip install news-please); alternatively aggregate "
+            "pre-downloaded article files with --txt-dir") from e
+    buffer = ArticleBuffer(os.path.join(outdir, "txt"), prefix,
+                           articles_per_write)
+
+    def on_article(article):
+        if article.language is not None and article.language != language:
+            return
+        text = article.maintext or ""
+        if not text.strip():
+            return
+        buffer.add("cc-" + (article.url or "unknown").replace(" ", ""), text)
+
+    def on_warc(*_args, **_kw):
+        buffer.flush()
+
+    commoncrawl_crawler.crawl_from_commoncrawl(
+        valid_hosts=[],
+        warc_files_start_date=start_date,
+        warc_files_end_date=end_date,
+        callback_on_article_extracted=on_article,
+        callback_on_warc_completed=on_warc,
+        continue_process=continue_process,
+        local_download_dir_warc=os.path.join(outdir, "warc"),
+        number_of_extraction_processes=1,
+    )
+    buffer.flush()
+
+
+def aggregate_txt(txt_dir, outdir, num_shards):
+    """Merge the streamed buffer files (one doc per line already) into the
+    standard round-robin source shards."""
+    writer = _ShardWriter(outdir, num_shards)
+    try:
+        for path in sorted(get_all_files_paths_under(txt_dir)):
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    parts = line.rstrip("\n").split(None, 1)
+                    if len(parts) == 2:
+                        writer.write(parts[0], parts[1])
+    finally:
+        writer.close()
+    return writer.num_documents
+
+
+def attach_args(parser=None):
+    parser = parser or argparse.ArgumentParser(
+        description="Download Common Crawl news and make source shards")
+    parser.add_argument("--outdir", required=True)
+    parser.add_argument("--prefix", default="cc",
+                        help="unique per host for multi-node downloads")
+    parser.add_argument("--num-shards", type=int, default=256)
+    parser.add_argument("--start-date", default=None, help="YYYY-MM-DD")
+    parser.add_argument("--end-date", default=None, help="YYYY-MM-DD")
+    parser.add_argument("--language", default="en")
+    parser.add_argument("--articles-per-write", type=int, default=1000)
+    parser.add_argument("--txt-dir", default=None,
+                        help="skip crawling; aggregate these buffer files")
+    parser.add_argument("--crawl-only", action="store_true",
+                        help="crawl without the final sharding (for "
+                             "multi-node: shard once after all hosts finish)")
+    return parser
+
+
+def _parse_date(s):
+    import datetime
+    return None if s is None else datetime.datetime.strptime(s, "%Y-%m-%d")
+
+
+def main(args=None):
+    args = args if args is not None else attach_args().parse_args()
+    outdir = expand_outdir_and_mkdir(args.outdir)
+    txt_dir = args.txt_dir
+    if txt_dir is None:
+        crawl(outdir, args.prefix,
+              start_date=_parse_date(args.start_date),
+              end_date=_parse_date(args.end_date),
+              language=args.language,
+              articles_per_write=args.articles_per_write)
+        txt_dir = os.path.join(outdir, "txt")
+    if not args.crawl_only:
+        n = aggregate_txt(txt_dir, outdir, args.num_shards)
+        print("common_crawl: {} articles -> {} shards".format(
+            n, args.num_shards))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""Shared downloader helpers.
+
+Reference parity: lddl/download/utils.py:30-51. The output contract every
+downloader must produce (consumed by lddl_tpu.preprocess.readers):
+``<outdir>/source/<i>.txt`` with ONE document per line whose first
+whitespace token is the document id.
+"""
+
+import os
+import sys
+import urllib.request
+
+
+def download(url, path, chunk_size=16 * 1024 * 1024, progress=True):
+    """Streaming HTTP(S) download to ``path`` (stdlib only — TPU pods often
+    lack requests/tqdm; zero-egress environments get a clear error)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    try:
+        with urllib.request.urlopen(url) as r, open(path, "wb") as f:
+            total = r.headers.get("Content-Length")
+            total = int(total) if total else None
+            done = 0
+            while True:
+                chunk = r.read(chunk_size)
+                if not chunk:
+                    break
+                f.write(chunk)
+                done += len(chunk)
+                if progress:
+                    pct = " {:.1f}%".format(100 * done / total) if total else ""
+                    sys.stderr.write("\r{} {:,} bytes{}".format(
+                        os.path.basename(path), done, pct))
+            if progress:
+                sys.stderr.write("\n")
+    except OSError as e:
+        raise RuntimeError(
+            "download of {} failed ({}); if this environment has no "
+            "egress, fetch the archive elsewhere and pass it via the "
+            "--local-* flag".format(url, e)) from e
+    return path
+
+
+class _ShardWriter:
+    """Writes documents round-robin into ``<outdir>/source/<i>.txt``."""
+
+    def __init__(self, outdir, num_shards, prefix=""):
+        # ``prefix`` namespaces shard files (e.g. per language) so multiple
+        # passes into one outdir never truncate each other's shards.
+        self._dir = os.path.join(outdir, "source")
+        os.makedirs(self._dir, exist_ok=True)
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self._files = [
+            open(os.path.join(self._dir, "{}{}.txt".format(prefix, i)), "w",
+                 encoding="utf-8") for i in range(num_shards)
+        ]
+        self._count = 0
+
+    def write(self, doc_id, text):
+        # One line per document; newlines inside the doc flatten to spaces.
+        text = " ".join(text.split())
+        if not text:
+            return
+        if any(c.isspace() for c in doc_id):
+            raise ValueError("doc id may not contain whitespace: "
+                             "{!r}".format(doc_id))
+        f = self._files[self._count % len(self._files)]
+        f.write(doc_id + " " + text + "\n")
+        self._count += 1
+
+    def close(self):
+        for f in self._files:
+            f.close()
+
+    @property
+    def num_documents(self):
+        return self._count
+
+
+def shard_documents(docs, outdir, num_shards):
+    """docs: iterable of (doc_id, text) -> source shards; returns count."""
+    writer = _ShardWriter(outdir, num_shards)
+    try:
+        for doc_id, text in docs:
+            writer.write(doc_id, text)
+    finally:
+        writer.close()
+    return writer.num_documents

@@ -1,6 +1,7 @@
 from .datasets import ShuffleBuffer, ParquetDataset
 from .dataloader import DataLoader, Binned
 from .bert import get_bert_pretrain_data_loader, BertPretrainBinned
+from .bart import get_bart_pretrain_data_loader, BartCollate
 from .sharding import process_dp_info, to_device_batch
 
 __all__ = [
@@ -9,6 +10,8 @@ __all__ = [
     "DataLoader",
     "Binned",
     "get_bert_pretrain_data_loader",
+    "get_bart_pretrain_data_loader",
+    "BartCollate",
     "BertPretrainBinned",
     "process_dp_info",
     "to_device_batch",

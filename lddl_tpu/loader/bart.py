@@ -1,0 +1,228 @@
+"""BART denoising data loader: text infilling + sentence permutation.
+
+The reference preprocesses BART chunks but ships NO BART loader in any
+framework (SURVEY.md §2.5: "no BART loader"); noising is left to the
+training side. lddl_tpu completes the path: this loader consumes the
+``{sentences}`` shards (lddl_tpu.preprocess.bart), applies the BART
+pretraining noise at load time on deterministic per-(epoch, dp group,
+worker) streams, and emits encoder/decoder numpy batches for a
+seq2seq trainer.
+
+Noising (Lewis et al. 2019, BART):
+- sentence permutation: the chunk's sentences are shuffled;
+- text infilling: token spans with Poisson(lambda=3) lengths are each
+  replaced by a single [MASK] until ~``mask_ratio`` of tokens are covered
+  (0-length spans insert a lone [MASK]).
+
+Batch keys: input_ids (noised), attention_mask, decoder_input_ids
+(shift-right of the clean sequence), labels (clean ids, ignore_index on
+padding).
+"""
+
+import numpy as np
+
+from ..ops.packing import round_up
+from ..preprocess.sentences import split_sentences
+from ..utils import rng as lrng
+from ..utils.fs import get_all_parquets_under
+from ..utils.logging import DatasetLogger
+from .dataloader import DataLoader
+from .datasets import ParquetDataset
+
+
+def decode_record_batch(b):
+    for s in b.column("sentences").to_pylist():
+        yield s
+
+
+class BartCollate:
+
+    needs_rng = True
+
+    def __init__(self, tokenizer, max_seq_length=128, mask_ratio=0.3,
+                 poisson_lambda=3.0, permute_sentences=True,
+                 sequence_length_alignment=8, fixed_seq_length=None,
+                 ignore_index=-1, decoder_start_token_id=None):
+        self._tokenizer = tokenizer
+        self._max_seq_length = max_seq_length
+        self._mask_ratio = mask_ratio
+        self._poisson_lambda = poisson_lambda
+        self._permute_sentences = permute_sentences
+        self._align = sequence_length_alignment
+        self._fixed_seq_length = fixed_seq_length
+        self._ignore_index = ignore_index
+        vocab = tokenizer.get_vocab()
+        self._mask_id = vocab["[MASK]"] if "[MASK]" in vocab else \
+            tokenizer.mask_token_id
+        self._cls_id = tokenizer.cls_token_id
+        self._sep_id = tokenizer.sep_token_id
+        self._pad_id = tokenizer.pad_token_id or 0
+        self._decoder_start = (decoder_start_token_id
+                               if decoder_start_token_id is not None
+                               else self._cls_id)
+
+    def _noise_ids(self, ids, g):
+        """Text infilling over one id list; returns the noised list."""
+        n = len(ids)
+        if n == 0:
+            return list(ids)
+        budget = int(round(n * self._mask_ratio))
+        out = list(ids)
+        # Sample span starts/lengths until the mask budget is spent.
+        covered = np.zeros(n, dtype=bool)
+        spans = []
+        tries = 0
+        while budget > 0 and tries < 4 * n:
+            tries += 1
+            length = int(g.poisson(self._poisson_lambda))
+            start = int(g.integers(0, n))
+            if length == 0:
+                spans.append((start, 0))
+                budget -= 1
+                continue
+            end = min(n, start + length)
+            if covered[start:end].any():
+                continue
+            covered[start:end] = True
+            spans.append((start, end - start))
+            budget -= (end - start)
+        # Apply right-to-left so indices stay valid.
+        for start, length in sorted(spans, reverse=True):
+            out[start:start + length] = [self._mask_id]
+        return out
+
+    def __call__(self, samples, g=None):
+        if g is None:
+            raise ValueError("BART noising needs a worker RNG")
+        tok = self._tokenizer
+        limit = self._max_seq_length - 2
+
+        # Tokenize each sentence separately (one batched call across the
+        # whole batch), so sentence permutation happens in TOKEN space on
+        # exactly the clean window: truncate first, then permute/infill —
+        # encoder input and labels always cover the same tokens.
+        per_sample_sentences = [split_sentences(c) for c in samples]
+        flat = [s for sents in per_sample_sentences for s in sents]
+        enc = tok(flat, add_special_tokens=False,
+                  return_attention_mask=False)["input_ids"] if flat else []
+        clean, noisy = [], []
+        k = 0
+        for sents in per_sample_sentences:
+            sample_enc = enc[k:k + len(sents)]
+            k += len(sents)
+            sent_ids = []
+            budget = limit
+            for ids in sample_enc:
+                if budget <= 0:
+                    break
+                ids = ids[:budget]
+                if ids:
+                    sent_ids.append(ids)
+                    budget -= len(ids)
+            clean.append([i for s in sent_ids for i in s])
+            if self._permute_sentences and len(sent_ids) > 1:
+                lrng.shuffle(g, sent_ids)
+            permuted = [i for s in sent_ids for i in s]
+            # Infilling can grow the sequence via 0-length inserts; clamp
+            # back to the window so fixed shapes always hold.
+            noisy.append(self._noise_ids(permuted, g)[:limit])
+
+        n = len(samples)
+        enc_lens = [len(x) + 2 for x in noisy]
+        dec_lens = [len(x) + 2 for x in clean]
+        longest = max(max(enc_lens), max(dec_lens))
+        if self._fixed_seq_length is not None:
+            if longest > self._fixed_seq_length:
+                raise ValueError(
+                    "sample of {} tokens exceeds fixed_seq_length {}".format(
+                        longest, self._fixed_seq_length))
+            L = self._fixed_seq_length
+        else:
+            L = round_up(longest, self._align)
+
+        input_ids = np.full((n, L), self._pad_id, dtype=np.int32)
+        attention_mask = np.zeros((n, L), dtype=np.int32)
+        decoder_input_ids = np.full((n, L), self._pad_id, dtype=np.int32)
+        labels = np.full((n, L), self._ignore_index, dtype=np.int32)
+        for i, (nz, cl) in enumerate(zip(noisy, clean)):
+            e = [self._cls_id] + nz + [self._sep_id]
+            d = [self._cls_id] + cl + [self._sep_id]
+            input_ids[i, :len(e)] = e
+            attention_mask[i, :len(e)] = 1
+            # Teacher forcing: decoder sees shift-right of the clean seq.
+            decoder_input_ids[i, 0] = self._decoder_start
+            decoder_input_ids[i, 1:len(d)] = d[:-1]
+            labels[i, :len(d)] = d
+        return {
+            "input_ids": input_ids,
+            "attention_mask": attention_mask,
+            "decoder_input_ids": decoder_input_ids,
+            "labels": labels,
+        }
+
+
+def get_bart_pretrain_data_loader(
+    path,
+    dp_rank=0,
+    num_dp_groups=1,
+    batch_size=64,
+    num_workers=1,
+    shuffle_buffer_size=16384,
+    shuffle_buffer_warmup_factor=16,
+    tokenizer=None,
+    vocab_file=None,
+    tokenizer_name=None,
+    max_seq_length=128,
+    mask_ratio=0.3,
+    poisson_lambda=3.0,
+    permute_sentences=True,
+    sequence_length_alignment=8,
+    fixed_seq_length=None,
+    ignore_index=-1,
+    base_seed=12345,
+    start_epoch=0,
+    log_dir=None,
+    log_level=None,
+    return_raw_samples=False,
+    prefetch=2,
+    comm=None,
+):
+    """BART denoising loader over ``{sentences}`` shards at ``path``."""
+    import logging
+    if tokenizer is None:
+        from ..preprocess.tokenizer import get_tokenizer
+        tokenizer = get_tokenizer(vocab_file=vocab_file,
+                                  pretrained_model_name=tokenizer_name)
+    logger = DatasetLogger(
+        log_dir=log_dir,
+        log_level=log_level if log_level is not None else logging.WARNING,
+        rank=dp_rank,
+    )
+    file_paths = get_all_parquets_under(path)
+    if not file_paths:
+        raise ValueError("no parquet shards under {}".format(path))
+    dataset = ParquetDataset(
+        file_paths,
+        base_seed=base_seed,
+        start_epoch=start_epoch,
+        dp_rank=dp_rank,
+        num_dp_groups=num_dp_groups,
+        num_workers=num_workers,
+        shuffle_buffer_size=shuffle_buffer_size,
+        shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+        decode_record_batch=decode_record_batch,
+        comm=comm,
+        logger=logger,
+    )
+    collate = None if return_raw_samples else BartCollate(
+        tokenizer,
+        max_seq_length=max_seq_length,
+        mask_ratio=mask_ratio,
+        poisson_lambda=poisson_lambda,
+        permute_sentences=permute_sentences,
+        sequence_length_alignment=sequence_length_alignment,
+        fixed_seq_length=fixed_seq_length,
+        ignore_index=ignore_index,
+    )
+    return DataLoader(dataset, batch_size, collate_fn=collate,
+                      prefetch=prefetch)

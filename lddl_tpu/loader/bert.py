@@ -78,7 +78,8 @@ class BertCollate:
                     "sample of {} tokens exceeds fixed_seq_length {}".format(
                         longest, self._fixed_seq_length))
             return self._fixed_seq_length
-        return ((longest - 1) // self._align + 1) * self._align
+        from ..ops.packing import round_up
+        return round_up(longest, self._align)
 
     def __call__(self, samples, g=None):
         n = len(samples)
@@ -150,7 +151,10 @@ class BertCollate:
 class BertPretrainBinned(Binned):
 
     def _get_batch_size(self, batch):
-        return len(batch["input_ids"])
+        # Encoded batches are dicts; return_raw_samples batches are lists.
+        if isinstance(batch, dict):
+            return len(batch["input_ids"])
+        return len(batch)
 
 
 def get_bert_pretrain_data_loader(

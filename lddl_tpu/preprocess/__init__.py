@@ -7,7 +7,8 @@ from .bert import (
     create_masked_lm_predictions,
 )
 from .binning import bin_id_of_num_tokens, num_bins
-from .runner import run_bert_preprocess
+from .runner import run_bert_preprocess, run_sharded_pipeline
+from .bart import BartPretrainConfig, run_bart_preprocess
 
 __all__ = [
     "Block",
@@ -23,4 +24,7 @@ __all__ = [
     "bin_id_of_num_tokens",
     "num_bins",
     "run_bert_preprocess",
+    "run_sharded_pipeline",
+    "BartPretrainConfig",
+    "run_bart_preprocess",
 ]

@@ -310,7 +310,9 @@ def _mask_whole_word(ids, candidate, num_to_predict, tok_info, g):
                 groups[-1].append(c)
             else:
                 groups.append([c])
-        order = g.permutation(len(groups))
+        # Stable argsort of raw uniforms (not Generator.permutation) keeps
+        # the stream numpy-version-stable, matching utils.rng.shuffle.
+        order = np.argsort(g.random(len(groups)), kind="stable")
         budget = int(num_to_predict[r])
         taken = 0
         for gi in order:

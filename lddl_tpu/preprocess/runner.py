@@ -158,36 +158,29 @@ def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
     return written
 
 
-def run_bert_preprocess(
+def run_sharded_pipeline(
     corpus_paths,
     out_dir,
-    tokenizer,
-    config=None,
+    process_bucket,
     num_blocks=64,
     sample_ratio=0.9,
     seed=12345,
-    bin_size=None,
     global_shuffle=True,
-    output_format="parquet",
     comm=None,
     log=None,
 ):
-    """Run the full BERT preprocessing pipeline.
+    """Generic SPMD scaffolding shared by every preprocessor: dirty-dir
+    guard -> block planning -> (optional) scatter shuffle -> strided bucket
+    processing via ``process_bucket(texts, bucket) -> {path: n}`` ->
+    cleanup + reduced totals.
 
     Returns {path: num_rows} for the shards written by THIS rank (ranks
-    own disjoint buckets; the balancer performs the global census). The
-    completion log line reports globally-reduced totals.
-
+    own disjoint buckets; the balancer performs the global census).
     SPMD: call on every host with the same arguments; hosts split the work
     by ``comm`` rank and meet at barriers.
     """
-    config = config or BertPretrainConfig()
     comm = comm or LocalCommunicator()
     log = log or (lambda msg: None)
-    if output_format not in ("parquet", "txt"):
-        raise ValueError("output_format must be parquet|txt")
-    if bin_size is not None:
-        binning_mod.num_bins(config.max_seq_length, bin_size)  # validate
 
     # Refuse a dirty output dir: stale part files from a previous run with a
     # different block count would silently survive next to fresh ones and
@@ -212,8 +205,6 @@ def run_bert_preprocess(
     nbuckets = len(blocks)
     log("{} input files -> {} blocks".format(len(input_files), len(blocks)))
 
-    tok_info = TokenizerInfo(tokenizer)
-
     if global_shuffle:
         _scatter_phase(blocks, out_dir, comm, sample_ratio, seed, nbuckets, log)
         comm.barrier()
@@ -227,9 +218,7 @@ def run_bert_preprocess(
                 text for _, text in read_documents(
                     blocks[bucket], sample_ratio=sample_ratio, base_seed=seed)
             ]
-        written.update(
-            _process_bucket(texts, bucket, tok_info, config, seed, out_dir,
-                            bin_size, output_format))
+        written.update(process_bucket(texts, bucket))
     comm.barrier()
 
     if global_shuffle and comm.rank == 0:
@@ -238,3 +227,41 @@ def run_bert_preprocess(
     log("preprocess done in {:.1f}s, {} shards, {} samples".format(
         time.time() - t0, int(totals[0]), int(totals[1])))
     return written
+
+
+def run_bert_preprocess(
+    corpus_paths,
+    out_dir,
+    tokenizer,
+    config=None,
+    num_blocks=64,
+    sample_ratio=0.9,
+    seed=12345,
+    bin_size=None,
+    global_shuffle=True,
+    output_format="parquet",
+    comm=None,
+    log=None,
+):
+    """Run the full BERT preprocessing pipeline (see run_sharded_pipeline
+    for the SPMD execution contract)."""
+    config = config or BertPretrainConfig()
+    if output_format not in ("parquet", "txt"):
+        raise ValueError("output_format must be parquet|txt")
+    if bin_size is not None:
+        binning_mod.num_bins(config.max_seq_length, bin_size)  # validate
+    tok_info = TokenizerInfo(tokenizer)
+
+    return run_sharded_pipeline(
+        corpus_paths,
+        out_dir,
+        lambda texts, bucket: _process_bucket(
+            texts, bucket, tok_info, config, seed, out_dir, bin_size,
+            output_format),
+        num_blocks=num_blocks,
+        sample_ratio=sample_ratio,
+        seed=seed,
+        global_shuffle=global_shuffle,
+        comm=comm,
+        log=log,
+    )

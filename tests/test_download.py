@@ -1,0 +1,98 @@
+"""Downloaders: sharding logic via local fixtures (no network)."""
+
+import os
+import tarfile
+
+import pytest
+
+from lddl_tpu.download.utils import _ShardWriter, shard_documents
+from lddl_tpu.download.wikipedia import aggregate_extracted
+from lddl_tpu.download.books import shard_books
+from lddl_tpu.download.openwebtext import shard_pages
+from lddl_tpu.download.common_crawl import ArticleBuffer, aggregate_txt
+from lddl_tpu.preprocess.readers import discover_source_files, read_documents, plan_blocks
+
+
+def _read_all_docs(outdir):
+    files = discover_source_files({"x": outdir})
+    docs = []
+    for b in plan_blocks(files, len(files)):
+        docs.extend(read_documents(b))
+    return docs
+
+
+def test_shard_writer_contract(tmp_path):
+    n = shard_documents(
+        [("id-{}".format(i), "text with\nnewlines {}".format(i))
+         for i in range(10)],
+        str(tmp_path), 3)
+    assert n == 10
+    docs = _read_all_docs(str(tmp_path))
+    assert len(docs) == 10
+    ids = {d for d, _ in docs}
+    assert ids == {"id-{}".format(i) for i in range(10)}
+    # Newlines flattened: one doc per line held.
+    assert all("\n" not in t for _, t in docs)
+    with pytest.raises(ValueError, match="whitespace"):
+        shard_documents([("bad id", "text")], str(tmp_path / "y"), 1)
+
+
+def test_wikipedia_aggregation(tmp_path):
+    extracted = tmp_path / "extracted" / "AA"
+    extracted.mkdir(parents=True)
+    (extracted / "wiki_00").write_text(
+        '<doc id="12" url="u" title="Python">\n'
+        "Python\n"
+        "\n"
+        "Python is a language.\n"
+        "It is widely used.\n"
+        "</doc>\n"
+        '<doc id="34" title="JAX">\n'
+        "JAX\n"
+        "JAX is a library.\n"
+        "</doc>\n")
+    out = str(tmp_path / "out")
+    n = aggregate_extracted(str(tmp_path / "extracted"), out, 2)
+    assert n == 2
+    docs = dict(_read_all_docs(out))
+    assert docs["wiki-12"] == "Python is a language. It is widely used."
+    assert docs["wiki-34"] == "JAX is a library."  # title dropped
+
+
+def test_books_sharding(tmp_path):
+    books = tmp_path / "books"
+    books.mkdir()
+    (books / "Moby Dick.txt").write_text("Call me Ishmael.\nSome years ago.")
+    (books / "notes.pdf").write_text("not a book")
+    out = str(tmp_path / "out")
+    n = shard_books(str(books), out, 1)
+    assert n == 1
+    docs = _read_all_docs(out)
+    assert docs[0][0] == "Moby-Dick.txt"
+    assert "Ishmael" in docs[0][1]
+
+
+def test_openwebtext_sharding(tmp_path):
+    pages = tmp_path / "pages" / "subset0"
+    pages.mkdir(parents=True)
+    (pages / "page-a.txt").write_text("Content of page a.")
+    (pages / "page-b.txt").write_text("Content of page b.")
+    out = str(tmp_path / "out")
+    n = shard_pages(str(tmp_path / "pages"), out, 2)
+    assert n == 2
+    ids = {d for d, _ in _read_all_docs(out)}
+    assert ids == {"page-a", "page-b"}
+
+
+def test_common_crawl_buffer_and_aggregate(tmp_path):
+    txt_dir = str(tmp_path / "txt")
+    buf = ArticleBuffer(txt_dir, "cc", articles_per_write=2)
+    for i in range(5):
+        buf.add("cc-article-{}".format(i), "Body number {}.".format(i))
+    buf.flush()
+    assert len(os.listdir(txt_dir)) == 3  # 2+2+1
+    out = str(tmp_path / "out")
+    n = aggregate_txt(txt_dir, out, 2)
+    assert n == 5
+    ids = {d for d, _ in _read_all_docs(out)}
+    assert ids == {"cc-article-{}".format(i) for i in range(5)}
