@@ -144,7 +144,14 @@ def main():
         mesh = make_mesh(axes)
         cfg = (BertConfig.tiny() if args.with_model == "tiny"
                else BertConfig.bert_base())
-        sample = next(iter(loader))
+        # Init from a synthetic batch: pulling one from the loader would
+        # advance the dataset's epoch counter and skip the first epoch's
+        # data (param init only needs the batch key/shape contract).
+        from lddl_tpu.models.testing import fake_pretrain_batch
+        init_len = (args.fixed_seq_lengths[0] if args.fixed_seq_lengths
+                    else 128)
+        sample = fake_pretrain_batch(cfg.vocab_size, args.batch_size,
+                                     init_len, seed=args.seed)
         state, _ = create_train_state(cfg, mesh, sample)
         step_fn = make_sharded_train_step(mesh, cfg)
 

@@ -36,6 +36,10 @@ def attach_args(parser=None):
     parser.add_argument("--num-blocks", type=int, default=64)
     parser.add_argument("--engine", choices=("numpy", "jax"), default="numpy",
                         help="masking kernel backend (jax = jit on TPU)")
+    parser.add_argument("--tokenizer-engine",
+                        choices=("auto", "hf", "native"), default="auto",
+                        help="sentence-split + tokenize backend (native = "
+                             "the C++ one-pass kernel)")
     parser.add_argument("--output-format", choices=("parquet", "txt"),
                         default="parquet")
     attach_bool_arg(parser, "global-shuffle", default=True,
@@ -59,6 +63,7 @@ def main(args=None):
         whole_word_masking=args.whole_word_masking,
         duplicate_factor=args.duplicate_factor,
         engine=args.engine,
+        tokenizer_engine=args.tokenizer_engine,
     )
     run_bert_preprocess(
         corpus_paths_of(args),

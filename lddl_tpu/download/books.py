@@ -10,14 +10,14 @@ import tarfile
 
 from ..utils.args import attach_bool_arg
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
-from .utils import _ShardWriter, download
+from .utils import _ShardWriter, download, safe_extractall
 
 _URL = "https://the-eye.eu/public/AI/pile_preliminary_components/books1.tar.gz"
 
 
 def untar(archive, outdir):
     with tarfile.open(archive, "r:gz") as tf:
-        tf.extractall(outdir, filter="data")
+        safe_extractall(tf, outdir)
 
 
 def shard_books(books_dir, outdir, num_shards):

@@ -12,7 +12,7 @@ import os
 import tarfile
 
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
-from .utils import _ShardWriter
+from .utils import _ShardWriter, safe_extractall
 
 _DRIVE_ID = "1EA5V0oetDCOke7afsktL_JDQ-ETtNOvx"
 
@@ -34,7 +34,7 @@ def extract_archive(archive, outdir):
     tar of per-page .txt files."""
     top = os.path.join(outdir, "openwebtext")
     with tarfile.open(archive, "r:*") as tf:
-        tf.extractall(outdir, filter="data")
+        safe_extractall(tf, outdir)
     extracted = os.path.join(outdir, "extracted")
     os.makedirs(extracted, exist_ok=True)
     for subset in sorted(os.listdir(top)):
@@ -43,9 +43,8 @@ def extract_archive(archive, outdir):
         sub_path = os.path.join(top, subset)
         with lzma.open(sub_path) as xz:
             with tarfile.open(fileobj=xz, mode="r:") as tf:
-                tf.extractall(
-                    os.path.join(extracted, subset[:-len(".xz")]),
-                    filter="data")
+                safe_extractall(
+                    tf, os.path.join(extracted, subset[:-len(".xz")]))
     return extracted
 
 

@@ -11,6 +11,15 @@ import sys
 import urllib.request
 
 
+def safe_extractall(tf, outdir):
+    """tarfile.extractall with the 'data' safety filter where available
+    (the filter kwarg only exists from Python 3.10.12 / 3.11.4 / 3.12)."""
+    try:
+        tf.extractall(outdir, filter="data")
+    except TypeError:
+        tf.extractall(outdir)
+
+
 def download(url, path, chunk_size=16 * 1024 * 1024, progress=True):
     """Streaming HTTP(S) download to ``path`` (stdlib only — TPU pods often
     lack requests/tqdm; zero-egress environments get a clear error)."""

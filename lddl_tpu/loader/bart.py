@@ -69,7 +69,14 @@ class BartCollate:
         budget = int(round(n * self._mask_ratio))
         out = list(ids)
         # Sample span starts/lengths until the mask budget is spent.
+        # Inserts (0-length spans) sit at gap positions 0..n; a replacement
+        # span (s, e) owns tokens s..e-1 and interior gaps s+1..e-1. Keeping
+        # spans and inserts off each other's territory guarantees the
+        # right-to-left application below never swallows an inserted [MASK]
+        # (and the spent budget always equals the masked token count).
         covered = np.zeros(n, dtype=bool)
+        gap_covered = np.zeros(n + 1, dtype=bool)   # gaps interior to a span
+        insert_at = np.zeros(n + 1, dtype=bool)     # gaps holding an insert
         spans = []
         tries = 0
         while budget > 0 and tries < 4 * n:
@@ -77,16 +84,22 @@ class BartCollate:
             length = int(g.poisson(self._poisson_lambda))
             start = int(g.integers(0, n))
             if length == 0:
+                if gap_covered[start]:
+                    continue
+                insert_at[start] = True
                 spans.append((start, 0))
                 budget -= 1
                 continue
             end = min(n, start + length)
-            if covered[start:end].any():
+            if covered[start:end].any() or insert_at[start + 1:end].any():
                 continue
             covered[start:end] = True
+            gap_covered[start + 1:end] = True
             spans.append((start, end - start))
             budget -= (end - start)
-        # Apply right-to-left so indices stay valid.
+        # Apply right-to-left so indices stay valid. At equal start, the
+        # replacement (longer) sorts after the insert and thus applies
+        # first, so boundary inserts survive too.
         for start, length in sorted(spans, reverse=True):
             out[start:start + length] = [self._mask_id]
         return out

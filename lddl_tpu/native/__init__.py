@@ -1,0 +1,173 @@
+"""ctypes binding for the native preprocessing engine.
+
+Public surface:
+    available() -> bool
+    NativeTokenizer(id_to_token, unk_id, do_lower_case)
+        .tokenize_docs(texts) -> (ids, sent_lens, doc_sent_counts) np arrays
+    split_docs(texts) -> list[list[str]]   (sentence split only; BART path)
+
+The engine replaces the reference's per-partition sentence-split + HF
+tokenize hot loop (lddl/dask/bert/pretrain.py:77-97) with one native pass;
+semantics parity with the Python/HF path is enforced by tests/test_native.py.
+"""
+
+import ctypes
+import os
+import threading
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+class _TokResult(ctypes.Structure):
+    _fields_ = [
+        ("ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_ids", ctypes.c_int64),
+        ("sent_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("n_sents", ctypes.c_int64),
+        ("doc_sent_counts", ctypes.POINTER(ctypes.c_int32)),
+        ("n_docs", ctypes.c_int64),
+    ]
+
+
+class _SplitResult(ctypes.Structure):
+    _fields_ = [
+        ("starts", ctypes.POINTER(ctypes.c_int64)),
+        ("ends", ctypes.POINTER(ctypes.c_int64)),
+        ("n_sents", ctypes.c_int64),
+        ("doc_sent_counts", ctypes.POINTER(ctypes.c_int32)),
+        ("n_docs", ctypes.c_int64),
+    ]
+
+
+def _load():
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("LDDL_TPU_DISABLE_NATIVE"):
+            return None
+        from .build import ensure_built
+        path = ensure_built()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.lddl_tok_create.restype = ctypes.c_void_p
+        lib.lddl_tok_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                        ctypes.c_int32, ctypes.c_int]
+        lib.lddl_tok_free.argtypes = [ctypes.c_void_p]
+        lib.lddl_tok_docs.restype = ctypes.POINTER(_TokResult)
+        lib.lddl_tok_docs.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.lddl_tok_result_free.argtypes = [ctypes.POINTER(_TokResult)]
+        lib.lddl_split_docs.restype = ctypes.POINTER(_SplitResult)
+        lib.lddl_split_docs.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+        lib.lddl_split_result_free.argtypes = [ctypes.POINTER(_SplitResult)]
+        if lib.lddl_native_abi_version() != 1:
+            return None
+        _lib = lib
+        return _lib
+
+
+def available():
+    return _load() is not None
+
+
+def _pack_docs(texts):
+    """Encode texts into one UTF-8 buffer + int64 offsets array."""
+    encoded = [t.encode("utf-8") for t in texts]
+    offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
+    np.cumsum([len(e) for e in encoded], out=offsets[1:])
+    return b"".join(encoded), offsets
+
+
+class NativeTokenizer:
+    """Native split+normalize+WordPiece over documents.
+
+    One instance holds the vocab hash table and the word->ids memo cache;
+    reuse it across buckets (the memo is what makes Zipf-distributed text
+    fast). Not thread-safe; use one instance per worker process.
+    """
+
+    def __init__(self, id_to_token, unk_id, do_lower_case=True):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native engine unavailable")
+        self._lib = lib
+        buf = "\n".join(id_to_token).encode("utf-8")
+        self._handle = lib.lddl_tok_create(buf, len(buf), int(unk_id),
+                                           1 if do_lower_case else 0)
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.lddl_tok_free(self._handle)
+            self._handle = None
+
+    def tokenize_docs(self, texts):
+        """-> (ids int32[], sent_lens int32[], doc_sent_counts int32[]).
+
+        Sentences are concatenated in document order; empty sentences are
+        dropped; doc_sent_counts[d] = number of non-empty sentences of
+        document d.
+        """
+        if not texts:
+            z = np.zeros(0, dtype=np.int32)
+            return z, z.copy(), z.copy()
+        buf, offsets = _pack_docs(texts)
+        res = self._lib.lddl_tok_docs(
+            self._handle, buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(texts))
+        try:
+            r = res.contents
+            ids = np.ctypeslib.as_array(r.ids, shape=(r.n_ids,)).copy()
+            sent_lens = np.ctypeslib.as_array(
+                r.sent_lens, shape=(r.n_sents,)).copy()
+            doc_counts = np.ctypeslib.as_array(
+                r.doc_sent_counts, shape=(r.n_docs,)).copy()
+        finally:
+            self._lib.lddl_tok_result_free(res)
+        return ids, sent_lens, doc_counts
+
+
+def split_docs(texts):
+    """Sentence-split documents natively -> list of sentence lists.
+
+    Same boundaries as preprocess.sentences.split_sentences (enforced by
+    tests); raises RuntimeError when the native engine is unavailable.
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native engine unavailable")
+    if not texts:
+        return []
+    buf, offsets = _pack_docs(texts)
+    res = lib.lddl_split_docs(
+        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(texts))
+    try:
+        r = res.contents
+        starts = np.ctypeslib.as_array(r.starts, shape=(r.n_sents,)).copy()
+        ends = np.ctypeslib.as_array(r.ends, shape=(r.n_sents,)).copy()
+        counts = np.ctypeslib.as_array(
+            r.doc_sent_counts, shape=(r.n_docs,)).copy()
+    finally:
+        lib.lddl_split_result_free(res)
+    out = []
+    k = 0
+    for d in range(len(texts)):
+        sents = []
+        for _ in range(int(counts[d])):
+            sents.append(buf[starts[k]:ends[k]].decode("utf-8"))
+            k += 1
+        out.append(sents)
+    return out

@@ -1,0 +1,59 @@
+"""Build the native engine shared library.
+
+Reference parity note: the reference obtains native performance by
+delegation (pyarrow C++, jemalloc, MPI — SURVEY.md §0); our runtime's own
+hot loop is native C++ compiled here. The library is built lazily on first
+use with the system g++ (baked into TPU images) and cached next to the
+sources; rebuilds trigger only when a source file is newer than the cached
+.so. Everything degrades gracefully: callers treat a failed build as
+"native engine unavailable" and fall back to the HF/Python path.
+"""
+
+import os
+import subprocess
+import tempfile
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_DIR, "lddl_native.cpp")
+TABLES = os.path.join(_DIR, "unicode_tables.h")
+LIB = os.path.join(_DIR, "_lddl_native.so")
+
+
+def _stale(target, sources):
+    if not os.path.exists(target):
+        return True
+    t = os.path.getmtime(target)
+    return any(os.path.getmtime(s) > t for s in sources if os.path.exists(s))
+
+
+def ensure_built(verbose=False):
+    """Build (if stale) and return the .so path, or None on failure."""
+    try:
+        if _stale(TABLES, [os.path.join(_DIR, "gen_tables.py")]):
+            from . import gen_tables
+            fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".h.tmp")
+            os.close(fd)
+            gen_tables.generate(tmp)
+            os.replace(tmp, TABLES)
+        if _stale(LIB, [SRC, TABLES]):
+            fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
+            os.close(fd)
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   SRC, "-o", tmp]
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                os.unlink(tmp)
+                if verbose:
+                    print("native build failed:\n" + proc.stderr)
+                return None
+            os.replace(tmp, LIB)  # atomic: concurrent builders race safely
+        return LIB
+    except Exception as e:  # missing g++, read-only fs, ...
+        if verbose:
+            print("native build unavailable: {}".format(e))
+        return None
+
+
+if __name__ == "__main__":
+    path = ensure_built(verbose=True)
+    print(path or "BUILD FAILED")

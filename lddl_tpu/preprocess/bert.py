@@ -45,12 +45,18 @@ class BertPretrainConfig:
     whole_word_masking: bool = False
     duplicate_factor: int = 5
     engine: str = "numpy"  # masking kernel: "numpy" | "jax"
+    # Sentence-split + tokenize engine: "native" = the C++ one-pass kernel
+    # (lddl_tpu.native), "hf" = Python splitter + HF fast tokenizer,
+    # "auto" = native when buildable + tokenizer-compatible, else hf.
+    tokenizer_engine: str = "auto"
 
     def __post_init__(self):
         if self.max_seq_length < 8:
             raise ValueError("max_seq_length too small")
         if self.engine not in ("numpy", "jax"):
             raise ValueError("engine must be numpy|jax")
+        if self.tokenizer_engine not in ("auto", "hf", "native"):
+            raise ValueError("tokenizer_engine must be auto|hf|native")
         if self.max_predictions_per_seq is None:
             self.max_predictions_per_seq = int(
                 np.ceil(self.masked_lm_ratio * self.max_seq_length))
@@ -72,7 +78,10 @@ class TokenizerInfo:
         self.sep_id = vocab["[SEP]"]
         self.mask_id = vocab["[MASK]"]
         self.pad_id = vocab.get("[PAD]", 0)
+        self.unk_id = vocab.get("[UNK]", 0)
+        self.do_lower_case = bool(getattr(tokenizer, "do_lower_case", True))
         self.vocab_size = size
+        self._native = None
         # Random-replacement masking draws from the full vocab (matching
         # Google's create_pretraining_data); the subword table supports
         # whole-word masking.
@@ -82,13 +91,88 @@ class TokenizerInfo:
     def join(self, ids):
         return " ".join(self.id_to_token[np.asarray(ids, dtype=np.int64)])
 
+    def native_tokenizer(self):
+        """Cached C++ engine instance, or None when unavailable or the
+        tokenizer's configuration differs from the semantics the native
+        kernel implements (WordPiece + default BertNormalizer pipeline)."""
+        if self._native is None:
+            from .. import native
+            backend = getattr(self.tokenizer, "_tokenizer", None)
+            if (backend is not None
+                    and _native_semantics_match(backend, self.do_lower_case)
+                    and native.available()):
+                unk = getattr(backend.model, "unk_token", "[UNK]")
+                self._native = native.NativeTokenizer(
+                    [str(t) for t in self.id_to_token],
+                    unk_id=self.tokenizer.get_vocab().get(unk, self.unk_id),
+                    do_lower_case=self.do_lower_case)
+            else:
+                self._native = False
+        return self._native or None
 
-def documents_from_texts(texts, tokenizer):
+
+def _native_semantics_match(backend, do_lower_case):
+    """True iff the HF backend's configuration matches the exact pipeline
+    the C++ kernel implements: clean_text + chinese-char spacing + NFD
+    accent strip (tied to lowercasing) + lowercase, BertPreTokenizer, and
+    '##'-prefixed WordPiece with the standard 100-char word cap. Any
+    deviation (e.g. strip_accents=False with do_lower_case=True) must fall
+    back to the HF engine rather than silently change token ids."""
+    try:
+        model = backend.model
+        if type(model).__name__ != "WordPiece":
+            return False
+        if getattr(model, "continuing_subword_prefix", "##") != "##":
+            return False
+        if getattr(model, "max_input_chars_per_word", 100) != 100:
+            return False
+        norm = backend.normalizer
+        if type(norm).__name__ != "BertNormalizer":
+            return False
+        if not getattr(norm, "clean_text", True):
+            return False
+        if not getattr(norm, "handle_chinese_chars", True):
+            return False
+        if bool(getattr(norm, "lowercase", do_lower_case)) != do_lower_case:
+            return False
+        strip = getattr(norm, "strip_accents", None)
+        if strip is not None and bool(strip) != do_lower_case:
+            return False
+        if type(backend.pre_tokenizer).__name__ != "BertPreTokenizer":
+            return False
+        return True
+    except Exception:
+        return False
+
+
+def documents_from_texts(texts, tokenizer, engine="auto"):
     """Raw document texts -> documents as lists of per-sentence id lists.
 
-    All sentences of the block tokenize in one batched fast-tokenizer call
-    (the reference tokenizes sentence-by-sentence, pretrain.py:77-97).
+    engine "native": one C++ pass (sentence split + normalize + memoized
+    WordPiece, lddl_tpu.native) over the whole block. engine "hf": Python
+    splitter + one batched fast-tokenizer call (the reference tokenizes
+    sentence-by-sentence, pretrain.py:77-97). "auto" prefers native.
     """
+    tok_info = tokenizer if isinstance(tokenizer, TokenizerInfo) else None
+    if tok_info is not None:
+        tokenizer = tok_info.tokenizer
+    if engine in ("auto", "native"):
+        if tok_info is None:
+            # Cache on the tokenizer object: TokenizerInfo holds the vocab
+            # tables and the native engine's word->ids memo, both of which
+            # must persist across per-block calls.
+            tok_info = getattr(tokenizer, "_lddl_tpu_tok_info", None)
+            if tok_info is None:
+                tok_info = TokenizerInfo(tokenizer)
+                try:
+                    tokenizer._lddl_tpu_tok_info = tok_info
+                except AttributeError:
+                    pass
+        nat = tok_info.native_tokenizer()
+        if nat is not None:
+            return _documents_from_texts_native(texts, nat)
+        if engine == "native":
+            raise RuntimeError("native tokenizer engine unavailable")
     doc_sentences = [split_sentences(t) for t in texts]
     flat = [s for sents in doc_sentences for s in sents]
     if not flat:
@@ -115,6 +199,25 @@ def documents_from_texts(texts, tokenizer):
             k += 1
             if ids:
                 doc.append(ids)
+        if doc:
+            documents.append(doc)
+    return documents
+
+
+def _documents_from_texts_native(texts, nat):
+    ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
+    flat = ids.tolist()
+    ends = np.cumsum(sent_lens)
+    documents = []
+    k = 0
+    pos = 0
+    for d in range(len(texts)):
+        doc = []
+        for _ in range(int(doc_counts[d])):
+            end = int(ends[k])
+            doc.append(flat[pos:end])
+            pos = end
+            k += 1
         if doc:
             documents.append(doc)
     return documents

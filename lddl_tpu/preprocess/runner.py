@@ -108,7 +108,8 @@ def _process_bucket(texts, bucket, tok_info, config, seed, out_dir, bin_size,
                     output_format):
     g = lrng.sample_rng(seed, 0x9A1A, bucket)
     lrng.shuffle(g, texts)
-    documents = documents_from_texts(texts, tok_info.tokenizer)
+    documents = documents_from_texts(texts, tok_info,
+                                     engine=config.tokenizer_engine)
     instances = pairs_from_documents(documents, config, g)
     rows = materialize_rows(instances, config, tok_info, seed,
                             (0x3A5C, bucket))

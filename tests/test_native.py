@@ -1,0 +1,130 @@
+"""Native C++ engine: parity with the Python splitter and the HF tokenizer.
+
+The native engine (lddl_tpu.native) replaces the preprocess hot loop
+(sentence split + BERT normalize + WordPiece). Its correctness contract is
+exact agreement with the Python-side semantics on BMP text, checked here
+sentence-by-sentence and id-by-id.
+"""
+
+import pytest
+
+from lddl_tpu import native
+from lddl_tpu.preprocess import build_wordpiece_vocab, get_tokenizer
+from lddl_tpu.preprocess.bert import TokenizerInfo, documents_from_texts
+from lddl_tpu.preprocess.sentences import split_sentences
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native engine did not build")
+
+DOCS = [
+    "Hello world. This is a test! Dr. Smith went to Washington. "
+    'He said "yes." Then left.',
+    "U.S. policy changed in 1999. The E.U. responded. Prices rose 3.5 "
+    "percent. Mr. J. R. Ewing agreed.",
+    "Unicode: café naïve Zürich über Straße. "
+    "“Quoted sentence.” Another one! "
+    "中文处理测试。 Mixed 中 text.",
+    "No terminator here",
+    "",
+    "   \t  ",
+    "Ellipsis... And then? Yes!! Done. (Parenthetical. Sentence.) [Also.] "
+    "'Quoted start.' Done again.",
+    "Numbers 3.14 and 2.71 stay. Version 2.0 shipped! approx. thirty "
+    "units. Fig. 4 shows it. Co. earnings rose.",
+    "A single letter J. Smith initial. Multi dots U.S.A. next sentence "
+    "Here. pp. 10-12 cited.",
+    "Tabs\tand\nnewlines\rmix.  Double  spaces.   End!",
+    "control\x01chars\x02here. \x00nul and � replacement. Fine.",
+    "ALL CAPS SENTENCE. lowercase start stays glued? Yes and no. "
+    "MixedCase Words Here.",
+]
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("nvocab") / "vocab.txt"
+    return build_wordpiece_vocab(DOCS * 3, str(path), vocab_size=400)
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(vocab_file):
+    return get_tokenizer(vocab_file=vocab_file)
+
+
+def test_split_parity():
+    got = native.split_docs(DOCS)
+    for text, sents in zip(DOCS, got):
+        assert sents == split_sentences(text), text
+
+
+def test_split_parity_no_boundary_cases():
+    cases = ["", ".", "...", "a.", "a. b", "a. B", '"a." B said.',
+             "x!? Y", "e.g. something", "i.e. another", "No. 5 ranked",
+             "end.)  Next", "end.” Next", "A.B.C. Next"]
+    got = native.split_docs(cases)
+    for text, sents in zip(cases, got):
+        assert sents == split_sentences(text), repr(text)
+
+
+def test_tokenize_parity_vs_hf(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    assert nat is not None
+    ids, sent_lens, doc_counts = nat.tokenize_docs(DOCS)
+    backend = hf_tokenizer._tokenizer
+    k = 0
+    pos = 0
+    for d, text in enumerate(DOCS):
+        expected_sents = [s for s in split_sentences(text)]
+        kept = 0
+        for s in expected_sents:
+            ref = backend.encode(s, add_special_tokens=False).ids
+            if not ref:
+                continue
+            n = int(sent_lens[k])
+            assert ids[pos:pos + n].tolist() == ref, s
+            k += 1
+            pos += n
+            kept += 1
+        assert int(doc_counts[d]) == kept
+    assert k == len(sent_lens) and pos == len(ids)
+
+
+def test_documents_from_texts_engines_agree(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    hf_docs = documents_from_texts(DOCS, hf_tokenizer, engine="hf")
+    native_docs = documents_from_texts(DOCS, info, engine="native")
+    assert native_docs == hf_docs
+
+
+def test_no_lower_case_parity(tmp_path):
+    vocab = build_wordpiece_vocab(DOCS * 2, str(tmp_path / "v.txt"),
+                                  vocab_size=400, do_lower_case=False)
+    tok = get_tokenizer(vocab_file=vocab, do_lower_case=False)
+    info = TokenizerInfo(tok)
+    nat = info.native_tokenizer()
+    assert nat is not None
+    backend = tok._tokenizer
+    ids, sent_lens, _ = nat.tokenize_docs(DOCS)
+    pos = 0
+    k = 0
+    for text in DOCS:
+        for s in split_sentences(text):
+            ref = backend.encode(s, add_special_tokens=False).ids
+            if not ref:
+                continue
+            n = int(sent_lens[k])
+            assert ids[pos:pos + n].tolist() == ref, s
+            pos += n
+            k += 1
+
+
+def test_memoization_consistency(hf_tokenizer):
+    """Repeated words must tokenize identically through the memo cache."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    text = "Hello world. " * 50
+    once, lens_once, _ = nat.tokenize_docs([text])
+    again, lens_again, _ = nat.tokenize_docs([text])
+    assert once.tolist() == again.tolist()
+    assert lens_once.tolist() == lens_again.tolist()
