@@ -13,11 +13,29 @@ import urllib.request
 
 def safe_extractall(tf, outdir):
     """tarfile.extractall with the 'data' safety filter where available
-    (the filter kwarg only exists from Python 3.10.12 / 3.11.4 / 3.12)."""
+    (the filter kwarg only exists from Python 3.10.12 / 3.11.4 / 3.12).
+    On older interpreters, members are validated by hand first — the
+    fallback must not reintroduce tar path traversal."""
     try:
         tf.extractall(outdir, filter="data")
+        return
     except TypeError:
-        tf.extractall(outdir)
+        pass
+    base = os.path.realpath(outdir)
+    for m in tf.getmembers():
+        target = os.path.realpath(os.path.join(base, m.name))
+        if target != base and not target.startswith(base + os.sep):
+            raise ValueError("unsafe tar member path: {}".format(m.name))
+        if m.issym() or m.islnk():
+            link = os.path.realpath(
+                os.path.join(os.path.dirname(target), m.linkname))
+            if link != base and not link.startswith(base + os.sep):
+                raise ValueError(
+                    "unsafe tar link target: {} -> {}".format(
+                        m.name, m.linkname))
+        if m.isdev():
+            raise ValueError("device node in tar: {}".format(m.name))
+    tf.extractall(outdir)
 
 
 def download(url, path, chunk_size=16 * 1024 * 1024, progress=True):

@@ -33,6 +33,17 @@ class _TokResult(ctypes.Structure):
     ]
 
 
+class _PairResult(ctypes.Structure):
+    _fields_ = [
+        ("seq_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_seq_ids", ctypes.c_int64),
+        ("seq_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("a_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("is_random_next", ctypes.POINTER(ctypes.c_uint8)),
+        ("n_instances", ctypes.c_int64),
+    ]
+
+
 class _SplitResult(ctypes.Structure):
     _fields_ = [
         ("starts", ctypes.POINTER(ctypes.c_int64)),
@@ -59,6 +70,13 @@ def _load():
             lib = ctypes.CDLL(path)
         except OSError:
             return None
+        # Version-gate BEFORE binding symbols: a cached .so from an older
+        # ABI must degrade to "unavailable", not raise AttributeError.
+        try:
+            if lib.lddl_native_abi_version() != 2:
+                return None
+        except AttributeError:
+            return None
         lib.lddl_tok_create.restype = ctypes.c_void_p
         lib.lddl_tok_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                         ctypes.c_int32, ctypes.c_int]
@@ -72,8 +90,13 @@ def _load():
         lib.lddl_split_docs.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
         lib.lddl_split_result_free.argtypes = [ctypes.POINTER(_SplitResult)]
-        if lib.lddl_native_abi_version() != 1:
-            return None
+        lib.lddl_bert_pairs.restype = ctypes.POINTER(_PairResult)
+        lib.lddl_bert_pairs.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
+        lib.lddl_pairs_free.argtypes = [ctypes.POINTER(_PairResult)]
         _lib = lib
         return _lib
 
@@ -137,6 +160,42 @@ class NativeTokenizer:
         finally:
             self._lib.lddl_tok_result_free(res)
         return ids, sent_lens, doc_counts
+
+
+def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
+               short_seq_prob, duplicate_factor, seed, bucket, cls_id,
+               sep_id):
+    """NSP pair creation over a tokenized bucket (lddl_tok_docs output),
+    replaying the frozen CounterRNG streams of the Python engine
+    (preprocess.bert.pairs_from_documents). Returns flat instance arrays
+    (seq_ids, seq_lens, a_lens, is_random_next)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native engine unavailable")
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    sent_lens = np.ascontiguousarray(sent_lens, dtype=np.int32)
+    doc_sent_counts = np.ascontiguousarray(doc_sent_counts, dtype=np.int32)
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    res = lib.lddl_bert_pairs(
+        ids.ctypes.data_as(p_i32), sent_lens.ctypes.data_as(p_i32),
+        len(sent_lens), doc_sent_counts.ctypes.data_as(p_i32),
+        len(doc_sent_counts), int(max_seq_length), float(short_seq_prob),
+        int(duplicate_factor), int(seed) & (2**64 - 1),
+        int(bucket) & (2**64 - 1), int(cls_id), int(sep_id))
+    try:
+        r = res.contents
+        n = r.n_instances
+        if n == 0:
+            z32 = np.zeros(0, dtype=np.int32)
+            return (z32, z32.copy(), z32.copy(), np.zeros(0, dtype=bool))
+        seq_ids = np.ctypeslib.as_array(r.seq_ids, shape=(r.n_seq_ids,)).copy()
+        seq_lens_o = np.ctypeslib.as_array(r.seq_lens, shape=(n,)).copy()
+        a_lens = np.ctypeslib.as_array(r.a_lens, shape=(n,)).copy()
+        rn = np.ctypeslib.as_array(r.is_random_next,
+                                   shape=(n,)).astype(bool)
+    finally:
+        lib.lddl_pairs_free(res)
+    return seq_ids, seq_lens_o, a_lens, rn
 
 
 def split_docs(texts):

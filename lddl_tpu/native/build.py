@@ -33,20 +33,27 @@ def ensure_built(verbose=False):
             from . import gen_tables
             fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".h.tmp")
             os.close(fd)
-            gen_tables.generate(tmp)
-            os.replace(tmp, TABLES)
+            try:
+                gen_tables.generate(tmp)
+                os.replace(tmp, TABLES)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         if _stale(LIB, [SRC, TABLES]):
             fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
             os.close(fd)
-            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                   SRC, "-o", tmp]
-            proc = subprocess.run(cmd, capture_output=True, text=True)
-            if proc.returncode != 0:
-                os.unlink(tmp)
-                if verbose:
-                    print("native build failed:\n" + proc.stderr)
-                return None
-            os.replace(tmp, LIB)  # atomic: concurrent builders race safely
+            try:
+                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                       SRC, "-o", tmp]
+                proc = subprocess.run(cmd, capture_output=True, text=True)
+                if proc.returncode != 0:
+                    if verbose:
+                        print("native build failed:\n" + proc.stderr)
+                    return None
+                os.replace(tmp, LIB)  # atomic: concurrent builders race
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
         return LIB
     except Exception as e:  # missing g++, read-only fs, ...
         if verbose:

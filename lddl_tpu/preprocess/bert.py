@@ -29,7 +29,6 @@ import dataclasses
 import numpy as np
 
 from ..ops.masking import mask_batch_numpy, make_jax_masker, plan_num_to_predict
-from ..ops.packing import pad_to_bucket
 from ..utils.fs import serialize_np_array
 from ..utils import rng as lrng
 from .sentences import split_sentences
@@ -74,6 +73,7 @@ class TokenizerInfo:
             self.id_to_token[i] = tok
         self.id_to_token = np.asarray(
             ["" if t is None else t for t in self.id_to_token], dtype=object)
+        self.token_list = self.id_to_token.tolist()  # plain-list fast path
         self.cls_id = vocab["[CLS]"]
         self.sep_id = vocab["[SEP]"]
         self.mask_id = vocab["[MASK]"]
@@ -204,6 +204,32 @@ def documents_from_texts(texts, tokenizer, engine="auto"):
     return documents
 
 
+def instances_from_texts(texts, tok_info, config, seed, bucket):
+    """Texts -> InstanceBatch via the configured engine (the whole bucket
+    hot path: split + tokenize + pair creation). Both engines emit
+    identical batches: tokenization parity plus the shared CounterRNG
+    contract make the native path a bit-exact replay of the Python one."""
+    if not isinstance(tok_info, TokenizerInfo):
+        tok_info = TokenizerInfo(tok_info)
+    engine = config.tokenizer_engine
+    nat = (tok_info.native_tokenizer()
+           if engine in ("auto", "native") else None)
+    if engine == "native" and nat is None:
+        raise RuntimeError("native tokenizer engine unavailable")
+    if nat is not None:
+        from .. import native
+        ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
+        seq_ids, seq_lens, a_lens, rn = native.bert_pairs(
+            ids, sent_lens, doc_counts, config.max_seq_length,
+            config.short_seq_prob, config.duplicate_factor, seed, bucket,
+            tok_info.cls_id, tok_info.sep_id)
+        return InstanceBatch(seq_ids, seq_lens, a_lens, rn)
+    documents = documents_from_texts(texts, tok_info, engine="hf")
+    instances = pairs_from_documents(documents, config, seed, bucket)
+    return InstanceBatch.from_pairs(instances, tok_info.cls_id,
+                                    tok_info.sep_id)
+
+
 def _documents_from_texts_native(texts, nat):
     ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
     flat = ids.tolist()
@@ -223,29 +249,56 @@ def _documents_from_texts_native(texts, nat):
     return documents
 
 
-def _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, g):
+# Domain tags of the frozen pair-creation RNG streams (see utils/rng.py:
+# CounterRNG — the cross-engine SplitMix64 contract shared with the C++
+# engine). One stream per (seed, bucket, duplicate-pass, document); one
+# shared stream for the in-bucket instance shuffle.
+PAIR_TAG = 0x1DD1_0004
+PAIR_SHUFFLE_TAG = 0x1DD1_0005
+
+
+def _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, rng):
     """Randomly truncate the longer of A/B from front or back until the pair
-    fits. (standard BERT truncation; ref pretrain.py:161-178)"""
-    while len(tokens_a) + len(tokens_b) > max_num_tokens:
-        trunc = tokens_a if len(tokens_a) > len(tokens_b) else tokens_b
-        if len(trunc) <= 1:
-            trunc = tokens_b if trunc is tokens_a else tokens_a
-            if len(trunc) <= 1:
+    fits; returns the (possibly sliced) pair. One RNG draw per removed
+    token, as in the standard algorithm (ref pretrain.py:161-178) — but
+    tracked as front/back counters and applied as two slices instead of
+    per-token list deletion."""
+    la, lb = len(tokens_a), len(tokens_b)
+    if la + lb <= max_num_tokens:
+        return tokens_a, tokens_b
+    fa = ba = fb = bb = 0  # front/back removals of a and b
+    while la + lb > max_num_tokens:
+        from_a = la > lb
+        if (la if from_a else lb) <= 1:
+            from_a = not from_a
+            if (la if from_a else lb) <= 1:
                 break
-        if g.random() < 0.5:
-            del trunc[0]
+        if from_a:
+            la -= 1
+            if rng.uniform() < 0.5:
+                fa += 1
+            else:
+                ba += 1
         else:
-            trunc.pop()
+            lb -= 1
+            if rng.uniform() < 0.5:
+                fb += 1
+            else:
+                bb += 1
+    return (tokens_a[fa:len(tokens_a) - ba],
+            tokens_b[fb:len(tokens_b) - bb])
 
 
-def create_pairs_from_document(all_documents, document_index, config, g):
+def create_pairs_from_document(all_documents, document_index, config, rng):
     """NSP pair instances (unmasked) from one document: list of
-    (a_ids, b_ids, is_random_next)."""
+    (a_ids, b_ids, is_random_next). ``rng`` is a CounterRNG on the frozen
+    cross-engine stream; the native engine replays the identical draw
+    sequence (one uniform per decision, one randint per index pick)."""
     document = all_documents[document_index]
     max_num_tokens = config.max_seq_length - 3
     target_seq_length = max_num_tokens
-    if g.random() < config.short_seq_prob:
-        target_seq_length = int(g.integers(2, max_num_tokens + 1))
+    if rng.uniform() < config.short_seq_prob:
+        target_seq_length = rng.randint(2, max_num_tokens + 1)
 
     instances = []
     current_chunk = []
@@ -259,13 +312,13 @@ def create_pairs_from_document(all_documents, document_index, config, g):
             if current_chunk:
                 a_end = 1
                 if len(current_chunk) >= 2:
-                    a_end = int(g.integers(1, len(current_chunk)))
+                    a_end = rng.randint(1, len(current_chunk))
                 tokens_a = []
                 for j in range(a_end):
                     tokens_a.extend(current_chunk[j])
 
                 tokens_b = []
-                if len(current_chunk) == 1 or g.random() < 0.5:
+                if len(current_chunk) == 1 or rng.uniform() < 0.5:
                     is_random_next = True
                     target_b_length = target_seq_length - len(tokens_a)
                     # Pick a different document (bounded retries mirror the
@@ -274,12 +327,12 @@ def create_pairs_from_document(all_documents, document_index, config, g):
                     random_document_index = document_index
                     if len(all_documents) > 1:
                         for _ in range(10):
-                            cand = int(g.integers(0, len(all_documents)))
+                            cand = rng.randint(0, len(all_documents))
                             if cand != document_index:
                                 random_document_index = cand
                                 break
                     random_document = all_documents[random_document_index]
-                    random_start = int(g.integers(0, len(random_document)))
+                    random_start = rng.randint(0, len(random_document))
                     for j in range(random_start, len(random_document)):
                         tokens_b.extend(random_document[j])
                         if len(tokens_b) >= target_b_length:
@@ -292,7 +345,8 @@ def create_pairs_from_document(all_documents, document_index, config, g):
                     for j in range(a_end, len(current_chunk)):
                         tokens_b.extend(current_chunk[j])
 
-                _truncate_seq_pair(tokens_a, tokens_b, max_num_tokens, g)
+                tokens_a, tokens_b = _truncate_seq_pair(
+                    tokens_a, tokens_b, max_num_tokens, rng)
                 if len(tokens_a) >= 1 and len(tokens_b) >= 1:
                     instances.append((tokens_a, tokens_b, is_random_next))
             current_chunk = []
@@ -301,27 +355,66 @@ def create_pairs_from_document(all_documents, document_index, config, g):
     return instances
 
 
-def pairs_from_documents(documents, config, g):
-    """All (a_ids, b_ids, is_random_next) instances for a block:
-    ``duplicate_factor`` passes, shuffled within the block."""
+def pairs_from_documents(documents, config, seed, bucket):
+    """All (a_ids, b_ids, is_random_next) instances for a bucket:
+    ``duplicate_factor`` passes over every document, then one in-bucket
+    shuffle. Streams are keyed per (seed, bucket, pass, document) so the
+    native engine can replay them in any order."""
     instances = []
-    for _ in range(config.duplicate_factor):
+    for dup in range(config.duplicate_factor):
         for doc_idx in range(len(documents)):
+            rng = lrng.CounterRNG(PAIR_TAG, seed, bucket, dup, doc_idx)
             instances.extend(
-                create_pairs_from_document(documents, doc_idx, config, g))
-    lrng.shuffle(g, instances)
-    return instances
+                create_pairs_from_document(documents, doc_idx, config, rng))
+    perm = lrng.stable_shuffle_perm(len(instances), PAIR_SHUFFLE_TAG, seed,
+                                    bucket)
+    return [instances[i] for i in perm]
 
 
-def _build_sequences(instances, tok_info):
-    """[CLS] a [SEP] b [SEP] id lists + per-row A lengths."""
-    seqs = []
-    a_lens = np.empty(len(instances), dtype=np.int32)
-    for i, (a, b, _) in enumerate(instances):
-        seqs.append([tok_info.cls_id] + a + [tok_info.sep_id] + b
-                    + [tok_info.sep_id])
-        a_lens[i] = len(a)
-    return seqs, a_lens
+@dataclasses.dataclass
+class InstanceBatch:
+    """One bucket's pretraining instances in flat array form — the native
+    engine's output format; the Python engine converts into it. Row i is
+    ``seq_ids[off_i : off_i + seq_lens[i]]`` = [CLS] a [SEP] b [SEP] with
+    ``a_lens[i]`` = len(a)."""
+
+    seq_ids: np.ndarray        # int32, all rows concatenated
+    seq_lens: np.ndarray       # int32 [n]
+    a_lens: np.ndarray         # int32 [n]
+    is_random_next: np.ndarray  # bool [n]
+
+    def __len__(self):
+        return len(self.seq_lens)
+
+    @classmethod
+    def from_pairs(cls, instances, cls_id, sep_id):
+        n = len(instances)
+        seq_lens = np.empty(n, dtype=np.int32)
+        a_lens = np.empty(n, dtype=np.int32)
+        rn = np.empty(n, dtype=bool)
+        flat = []
+        for i, (a, b, r) in enumerate(instances):
+            flat.append(cls_id)
+            flat.extend(a)
+            flat.append(sep_id)
+            flat.extend(b)
+            flat.append(sep_id)
+            seq_lens[i] = len(a) + len(b) + 3
+            a_lens[i] = len(a)
+            rn[i] = r
+        return cls(np.asarray(flat, dtype=np.int32), seq_lens, a_lens, rn)
+
+    def padded(self, pad_id, length_multiple, min_length):
+        """(ids, valid) 2-D arrays, width padded up to a lane-aligned
+        bucket so jit compilations stay bounded."""
+        from ..ops.packing import round_up
+        n = len(self)
+        width = max(min_length,
+                    round_up(int(self.seq_lens.max()), length_multiple))
+        valid = np.arange(width)[None, :] < self.seq_lens[:, None]
+        ids = np.full((n, width), pad_id, dtype=np.int32)
+        ids[valid] = self.seq_ids  # row-major fill matches flat order
+        return ids, valid
 
 
 def _candidate_mask(valid, a_lens, seq_lens):
@@ -334,19 +427,23 @@ def _candidate_mask(valid, a_lens, seq_lens):
     return candidate
 
 
-def apply_static_masking(instances, config, tok_info, seed, scope):
-    """Batch-mask all instances of a bucket; returns per-row
-    (masked_seq_ids, positions, label_ids).
+def apply_static_masking(batch, config, tok_info, seed, scope):
+    """Batch-mask all instances of a bucket (an InstanceBatch or a list of
+    (a, b, is_random_next) pairs); returns batch arrays (masked ids,
+    selected mask, original ids, a_lens, seq_lens) — callers slice rows
+    out (positions of row i = nonzero(selected[i]), labels =
+    ids[i, positions]).
 
     Engine "numpy": vectorized host kernel on a Philox stream.
     Engine "jax": jit'd kernel (TPU when available), padded to lane-aligned
     buckets so compilations stay bounded.
     """
-    seqs, a_lens = _build_sequences(instances, tok_info)
-    seq_lens = np.asarray([len(s) for s in seqs], dtype=np.int32)
+    if isinstance(batch, list):
+        batch = InstanceBatch.from_pairs(batch, tok_info.cls_id,
+                                         tok_info.sep_id)
+    a_lens, seq_lens = batch.a_lens, batch.seq_lens
     width = min(128, config.max_seq_length)
-    ids, valid = pad_to_bucket(seqs, pad_id=tok_info.pad_id,
-                               length_multiple=width, min_length=width)
+    ids, valid = batch.padded(tok_info.pad_id, width, width)
     candidate = _candidate_mask(valid, a_lens, seq_lens)
     num_to_predict = plan_num_to_predict(seq_lens, config.masked_lm_ratio,
                                          config.max_predictions_per_seq)
@@ -380,12 +477,7 @@ def apply_static_masking(instances, config, tok_info, seed, scope):
             ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),
             tok_info.mask_id, tok_info.vocab_size)
 
-    out = []
-    for i in range(len(seqs)):
-        positions = np.nonzero(selected[i])[0].astype(np.uint16)
-        labels = ids[i, positions]
-        out.append((masked[i], positions, labels))
-    return out, a_lens, seq_lens
+    return masked, selected, ids, a_lens, seq_lens
 
 
 _JAX_MASKERS = {}
@@ -435,32 +527,60 @@ def _mask_whole_word(ids, candidate, num_to_predict, tok_info, g):
     return out, selected
 
 
-def materialize_rows(instances, config, tok_info, seed, scope):
-    """Instances -> parquet row dicts (strings), applying static masking
-    batch-wise when configured."""
-    if not config.masking:
-        return [{
-            "A": tok_info.join(a),
-            "B": tok_info.join(b),
-            "is_random_next": bool(rn),
-            "num_tokens": len(a) + len(b) + 3,
-        } for a, b, rn in instances]
+def materialize_rows(batch, config, tok_info, seed, scope):
+    """Instances (InstanceBatch or list of (a, b, is_random_next)) ->
+    parquet row dicts (strings), applying static masking batch-wise when
+    configured. String materialization is batched: one object-array gather
+    over the whole bucket, then plain list joins."""
+    if isinstance(batch, list):
+        batch = InstanceBatch.from_pairs(batch, tok_info.cls_id,
+                                         tok_info.sep_id)
+    n = len(batch)
+    if n == 0:
+        return []
+    a_lens, seq_lens = batch.a_lens, batch.seq_lens
+    rn = batch.is_random_next
 
-    masked_rows, a_lens, seq_lens = apply_static_masking(
-        instances, config, tok_info, seed, scope)
+    if not config.masking:
+        tl = tok_info.token_list
+        flat = batch.seq_ids.tolist()
+        offsets = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum(seq_lens, out=offsets[1:])
+        rows = []
+        for i in range(n):
+            o = int(offsets[i])
+            la = int(a_lens[i])
+            end = int(seq_lens[i])
+            rows.append({
+                "A": " ".join([tl[t] for t in flat[o + 1:o + 1 + la]]),
+                "B": " ".join([tl[t] for t in flat[o + 2 + la:o + end - 1]]),
+                "is_random_next": bool(rn[i]),
+                "num_tokens": end,
+            })
+        return rows
+
+    masked, selected, ids, a_lens, seq_lens = apply_static_masking(
+        batch, config, tok_info, seed, scope)
+    width = int(seq_lens.max())
+    tok_rows = tok_info.id_to_token[masked[:, :width]].tolist()
+    sel_rows, sel_cols = np.nonzero(selected)            # row-major: sorted
+    label_toks = tok_info.id_to_token[ids[sel_rows, sel_cols]].tolist()
+    positions = sel_cols.astype(np.uint16)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(np.bincount(sel_rows, minlength=n), out=offsets[1:])
     rows = []
-    for i, (inst, (masked_seq, positions, label_ids)) in enumerate(
-            zip(instances, masked_rows)):
+    for i in range(n):
         la = int(a_lens[i])
         end = int(seq_lens[i])
+        trow = tok_rows[i]
+        s, e = int(offsets[i]), int(offsets[i + 1])
         rows.append({
-            "A": tok_info.join(masked_seq[1:1 + la]),
-            "B": tok_info.join(masked_seq[2 + la:end - 1]),
-            "is_random_next": bool(inst[2]),
+            "A": " ".join(trow[1:1 + la]),
+            "B": " ".join(trow[2 + la:end - 1]),
+            "is_random_next": bool(rn[i]),
             "num_tokens": end,
-            "masked_lm_positions": serialize_np_array(
-                positions.astype(np.uint16)),
-            "masked_lm_labels": tok_info.join(label_ids),
+            "masked_lm_positions": serialize_np_array(positions[s:e]),
+            "masked_lm_labels": " ".join(label_toks[s:e]),
         })
     return rows
 

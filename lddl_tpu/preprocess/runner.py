@@ -35,9 +35,8 @@ from ..utils import rng as lrng
 from .bert import (
     BertPretrainConfig,
     TokenizerInfo,
-    documents_from_texts,
+    instances_from_texts,
     materialize_rows,
-    pairs_from_documents,
 )
 from .readers import discover_source_files, plan_blocks, read_documents
 from . import binning as binning_mod
@@ -108,11 +107,8 @@ def _process_bucket(texts, bucket, tok_info, config, seed, out_dir, bin_size,
                     output_format):
     g = lrng.sample_rng(seed, 0x9A1A, bucket)
     lrng.shuffle(g, texts)
-    documents = documents_from_texts(texts, tok_info,
-                                     engine=config.tokenizer_engine)
-    instances = pairs_from_documents(documents, config, g)
-    rows = materialize_rows(instances, config, tok_info, seed,
-                            (0x3A5C, bucket))
+    batch = instances_from_texts(texts, tok_info, config, seed, bucket)
+    rows = materialize_rows(batch, config, tok_info, seed, (0x3A5C, bucket))
     if output_format == "txt":
         return _write_txt_shard(rows, out_dir, bucket, config.masking,
                                 bin_size, config.max_seq_length)

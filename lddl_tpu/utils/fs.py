@@ -110,16 +110,24 @@ def write_num_samples_cache(dir_path, counts):
 
 
 def serialize_np_array(a):
-    """numpy array -> bytes, for storing arrays in parquet columns.
+    """numpy 1-D array -> bytes, for storing arrays in parquet columns.
 
     Used for static-masking outputs (masked positions / labels) which are
-    ragged per-row int arrays. (ref: lddl/utils.py:98-106)
+    ragged per-row int arrays. (ref: lddl/utils.py:98-106 — which uses the
+    .npy container; that costs a ~128-byte header plus Python-side header
+    formatting per row, so we use a 4-byte tag + raw little-endian payload
+    instead and keep an .npy-compatible read path for old shards.)
     """
-    buf = io.BytesIO()
-    np.save(buf, a, allow_pickle=False)
-    return buf.getvalue()
+    a = np.ascontiguousarray(a)
+    code = a.dtype.str.encode()  # e.g. b'<u2'
+    if len(code) != 3 or a.ndim != 1:
+        buf = io.BytesIO()  # rare shapes/dtypes: fall back to .npy
+        np.save(buf, a, allow_pickle=False)
+        return buf.getvalue()
+    return b"R" + code + a.tobytes()
 
 
 def deserialize_np_array(b):
-    buf = io.BytesIO(b)
-    return np.load(buf, allow_pickle=False)
+    if b[:1] == b"R":
+        return np.frombuffer(b, dtype=b[1:4].decode(), offset=4)
+    return np.load(io.BytesIO(b), allow_pickle=False)

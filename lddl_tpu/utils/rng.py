@@ -90,6 +90,86 @@ def shuffle(rng, seq):
     return seq
 
 
+# ---------------------------------------------------------------------------
+# Cross-engine counter RNG (SplitMix64 contract).
+#
+# Pair creation runs in either the Python engine or the native C++ engine;
+# both must emit bit-identical samples. numpy Generator internals are not
+# reproducible from C++, so the pair-creation randomness is FROZEN as this
+# counter-based SplitMix64 scheme (documented here, mirrored in
+# lddl_tpu/native/lddl_native.cpp, pinned by tests/test_rng.py goldens):
+#
+#   key      = fold(parts): k := mix64(k + p_i) starting from 0xA0761D6478BD642F
+#   draw(i)  = mix64(key + (i+1) * 0x9E3779B97F4A7C15),  i = 0, 1, 2, ...
+#   uniform  = (draw >> 11) * 2^-53                      in [0, 1)
+#   randint(lo, hi) = lo + draw % (hi - lo)              (frozen incl. the
+#                                                         negligible mod bias)
+#   shuffle perm(n) = stable argsort of [uniform(0..n-1)]
+# ---------------------------------------------------------------------------
+
+_MASK64 = (1 << 64) - 1
+_GOLDEN = 0x9E3779B97F4A7C15
+_KEY_INIT = 0xA0761D6478BD642F
+
+
+def mix64(z):
+    """SplitMix64 finalizer (Steele et al.) on a 64-bit int."""
+    z &= _MASK64
+    z ^= z >> 30
+    z = (z * 0xBF58476D1CE4E5B9) & _MASK64
+    z ^= z >> 27
+    z = (z * 0x94D049BB133111EB) & _MASK64
+    z ^= z >> 31
+    return z
+
+
+def stream_key(*parts):
+    """Fold integer scope parts into a 64-bit stream key."""
+    k = _KEY_INIT
+    for p in parts:
+        k = mix64((k + (int(p) & _MASK64)) & _MASK64)
+    return k
+
+
+class CounterRNG:
+    """Sequential draws from one SplitMix64 stream (the frozen contract
+    above). Scalar and pure-Python by design: this is the reference
+    implementation the native engine must match draw-for-draw."""
+
+    __slots__ = ("key", "i")
+
+    def __init__(self, *parts):
+        self.key = stream_key(*parts)
+        self.i = 0
+
+    def next_u64(self):
+        self.i += 1
+        return mix64((self.key + self.i * _GOLDEN) & _MASK64)
+
+    def uniform(self):
+        return (self.next_u64() >> 11) * (2.0 ** -53)
+
+    def randint(self, lo, hi):
+        """One draw in [lo, hi) — hi exclusive, hi > lo."""
+        return lo + self.next_u64() % (hi - lo)
+
+
+def stable_shuffle_perm(n, *parts):
+    """Permutation of range(n): stable argsort of the stream's first n
+    uniforms. Vectorized (uint64 numpy ops are bit-exact vs the scalar
+    contract); the C++ engine mirrors it with std::stable_sort."""
+    key = np.uint64(stream_key(*parts))
+    idx = np.arange(1, n + 1, dtype=np.uint64)
+    z = key + idx * np.uint64(_GOLDEN)
+    z ^= z >> np.uint64(30)
+    z *= np.uint64(0xBF58476D1CE4E5B9)
+    z ^= z >> np.uint64(27)
+    z *= np.uint64(0x94D049BB133111EB)
+    z ^= z >> np.uint64(31)
+    u = (z >> np.uint64(11)).astype(np.float64) * (2.0 ** -53)
+    return np.argsort(u, kind="stable")
+
+
 def choices(rng, population, weights, k=1):
     """Weighted sampling with replacement (like random.choices)."""
     w = np.asarray(weights, dtype=np.float64)

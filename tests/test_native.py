@@ -37,6 +37,13 @@ DOCS = [
     "control\x01chars\x02here. \x00nul and � replacement. Fine.",
     "ALL CAPS SENTENCE. lowercase start stays glued? Yes and no. "
     "MixedCase Words Here.",
+    # Separator / format characters where the HF fast normalizer's real
+    # behavior was verified empirically: U+2028/U+2029 -> space, Cf chars
+    # (soft hyphen, ZWJ, ZWSP, BOM) and C-category whitespace (NEL, VT)
+    # -> removed, CJK compatibility ideograph U+F900 -> folds to U+8C48.
+    "line separated. para separated here. "
+    "soft­hyphen zero​width joined‍chars bom﻿mark. "
+    "nelchar vtchar done. Compat 豈 ideograph.",
 ]
 
 
@@ -117,6 +124,77 @@ def test_no_lower_case_parity(tmp_path):
             assert ids[pos:pos + n].tolist() == ref, s
             pos += n
             k += 1
+
+
+def test_pair_engine_parity(hf_tokenizer):
+    """The native pair-creation path must be a bit-exact replay of the
+    Python engine: same instances, same order, same masking inputs."""
+    from lddl_tpu.preprocess.bert import (BertPretrainConfig,
+                                          instances_from_texts)
+    texts = [d for d in DOCS if d.strip()] * 4
+    info = TokenizerInfo(hf_tokenizer)
+    cfg_native = BertPretrainConfig(max_seq_length=48, duplicate_factor=3,
+                                    tokenizer_engine="native")
+    cfg_hf = BertPretrainConfig(max_seq_length=48, duplicate_factor=3,
+                                tokenizer_engine="hf")
+    for seed, bucket in [(0, 0), (12345, 7), (99, 3)]:
+        nb = instances_from_texts(list(texts), info, cfg_native, seed, bucket)
+        pb = instances_from_texts(list(texts), info, cfg_hf, seed, bucket)
+        assert len(nb) == len(pb) > 0
+        assert nb.seq_lens.tolist() == pb.seq_lens.tolist()
+        assert nb.a_lens.tolist() == pb.a_lens.tolist()
+        assert nb.is_random_next.tolist() == pb.is_random_next.tolist()
+        assert nb.seq_ids.tolist() == pb.seq_ids.tolist()
+
+
+def test_e2e_engine_parity(hf_tokenizer, tmp_path):
+    """Full preprocess runs (masked + binned) with the hf and native
+    engines must write identical shard contents."""
+    import pyarrow.parquet as pq
+    from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
+    from lddl_tpu.utils.fs import get_all_parquets_under
+
+    source = tmp_path / "corpus" / "source"
+    source.mkdir(parents=True)
+    with open(source / "0.txt", "w") as f:
+        for i, d in enumerate(DOCS * 3):
+            if d.strip():
+                f.write("doc-{} {}\n".format(i, d.replace("\n", " ")
+                                             .replace("\r", " ")
+                                             .replace("\t", " ")
+                                             .replace("\x00", "")))
+    outs = {}
+    for engine in ("hf", "native"):
+        out = tmp_path / ("out_" + engine)
+        run_bert_preprocess(
+            {"wikipedia": str(tmp_path / "corpus")}, str(out), hf_tokenizer,
+            config=BertPretrainConfig(max_seq_length=48, duplicate_factor=2,
+                                      masking=True,
+                                      tokenizer_engine=engine),
+            num_blocks=3, sample_ratio=1.0, seed=7, bin_size=16)
+        rows = {}
+        for p in sorted(get_all_parquets_under(str(out))):
+            rel = p[len(str(out)):]
+            rows[rel] = pq.read_table(p).to_pylist()
+        outs[engine] = rows
+    assert outs["hf"] == outs["native"]
+    assert sum(len(v) for v in outs["hf"].values()) > 0
+
+
+def test_counter_rng_parity_goldens():
+    """Pin the Python CounterRNG contract (the C++ mirror is covered by
+    the engine-parity tests above; these goldens freeze the spec itself)."""
+    from lddl_tpu.utils.rng import CounterRNG, stable_shuffle_perm
+    r = CounterRNG(0x1DD1_0004, 1, 2, 3, 4)
+    seq = [r.next_u64() for _ in range(3)]
+    r2 = CounterRNG(0x1DD1_0004, 1, 2, 3, 4)
+    assert [r2.next_u64() for _ in range(3)] == seq
+    assert all(0.0 <= CounterRNG(i).uniform() < 1.0 for i in range(50))
+    vals = [CounterRNG(9, 9, i).randint(0, 10) for i in range(200)]
+    assert set(vals) == set(range(10))  # full range coverage w.h.p.
+    perm = stable_shuffle_perm(16, 5, 6)
+    assert sorted(perm.tolist()) == list(range(16))
+    assert stable_shuffle_perm(16, 5, 6).tolist() == perm.tolist()
 
 
 def test_memoization_consistency(hf_tokenizer):

@@ -67,3 +67,25 @@ def test_validation():
         lrng.worker_rng(7, 0, 4, 4, 0, 1)
     with pytest.raises(ValueError):
         lrng.worker_rng(7, 0, 0, 4, 2, 2)
+
+
+def test_counter_rng_frozen_goldens():
+    """Literal goldens for the cross-engine SplitMix64 contract
+    (utils/rng.py <-> lddl_tpu/native/lddl_native.cpp). These values are
+    FROZEN: changing any constant or the draw scheme silently breaks
+    reproducibility of previously preprocessed shards and the native
+    engine's bit-parity — if this test fails, revert the RNG change."""
+    assert lrng.stream_key(0x1DD1_0004, 12345, 7, 0, 3) == 0xC17DF576A6874A87
+    r = lrng.CounterRNG(0x1DD1_0004, 12345, 7, 0, 3)
+    assert [r.next_u64() for _ in range(4)] == [
+        0x3F34554D8373CD39, 0xFFDF8E23A2B26E7B,
+        0x450657E4DF8E009C, 0xEFA7A6498DDB4959]
+    r = lrng.CounterRNG(1, 2, 3)
+    got = [r.uniform() for _ in range(3)]
+    expected = [0.559230607239236, 0.5177942814535528, 0.6176986217129953]
+    assert got == expected  # exact: same doubles, not approx
+    r = lrng.CounterRNG(42)
+    assert [r.randint(0, 1000) for _ in range(6)] == [686, 429, 951, 704,
+                                                      26, 229]
+    perm = lrng.stable_shuffle_perm(10, 0x1DD1_0005, 5, 2)
+    assert perm.tolist() == [7, 9, 8, 1, 4, 6, 0, 3, 5, 2]
