@@ -20,6 +20,9 @@ def attach_args(parser=None):
     parser.add_argument("--sample-ratio", type=float, default=0.9)
     parser.add_argument("--seed", type=int, default=12345)
     parser.add_argument("--num-blocks", type=int, default=64)
+    parser.add_argument("--local-workers", type=int, default=0,
+                        help="process-pool size per host "
+                             "(0 = one per CPU core)")
     parser.add_argument("--output-format", choices=("parquet", "txt"),
                         default="parquet")
     attach_bool_arg(parser, "global-shuffle", default=True)
@@ -27,6 +30,7 @@ def attach_args(parser=None):
 
 
 def main(args=None):
+    import os
     args = args if args is not None else attach_args().parse_args()
     comm = communicator_of(args)
     run_bart_preprocess(
@@ -36,6 +40,7 @@ def main(args=None):
             target_seq_length=args.target_seq_length,
             short_seq_prob=args.short_seq_prob,
         ),
+        num_workers=args.local_workers or os.cpu_count() or 1,
         num_blocks=args.num_blocks,
         sample_ratio=args.sample_ratio,
         seed=args.seed,

@@ -34,6 +34,10 @@ def attach_args(parser=None):
     parser.add_argument("--seed", type=int, default=12345)
     parser.add_argument("--bin-size", type=int, default=None)
     parser.add_argument("--num-blocks", type=int, default=64)
+    parser.add_argument("--local-workers", type=int, default=0,
+                        help="process-pool size per host for bucket "
+                             "processing (0 = one per CPU core; the "
+                             "reference runs ~128 MPI ranks per node)")
     parser.add_argument("--engine", choices=("numpy", "jax"), default="numpy",
                         help="masking kernel backend (jax = jit on TPU)")
     parser.add_argument("--tokenizer-engine",
@@ -65,11 +69,13 @@ def main(args=None):
         engine=args.engine,
         tokenizer_engine=args.tokenizer_engine,
     )
+    import os
     run_bert_preprocess(
         corpus_paths_of(args),
         args.sink,
         tokenizer,
         config=config,
+        num_workers=args.local_workers or os.cpu_count() or 1,
         num_blocks=args.num_blocks,
         sample_ratio=args.sample_ratio,
         seed=args.seed,

@@ -61,24 +61,34 @@ def chunks_from_text(text, config, g):
     return chunks
 
 
-def _process_bucket(texts, bucket, config, seed, out_dir, output_format):
-    g = lrng.sample_rng(seed, 0xBA27, bucket)
-    lrng.shuffle(g, texts)
-    rows = []
-    for text in texts:
-        rows.extend(chunks_from_text(text, config, g))
-    os.makedirs(out_dir, exist_ok=True)
-    if output_format == "txt":
-        path = os.path.join(out_dir, "{}.txt".format(bucket))
-        with open(path, "w", encoding="utf-8") as f:
-            for r in rows:
-                f.write(r + "\n")
+class BartBucketProcessor:
+    """Picklable per-bucket BART pipeline stage (pool-friendly; see
+    runner.BertBucketProcessor)."""
+
+    def __init__(self, config, seed, out_dir, output_format):
+        self.config = config
+        self.seed = seed
+        self.out_dir = out_dir
+        self.output_format = output_format
+
+    def __call__(self, texts, bucket):
+        g = lrng.sample_rng(self.seed, 0xBA27, bucket)
+        lrng.shuffle(g, texts)
+        rows = []
+        for text in texts:
+            rows.extend(chunks_from_text(text, self.config, g))
+        os.makedirs(self.out_dir, exist_ok=True)
+        if self.output_format == "txt":
+            path = os.path.join(self.out_dir, "{}.txt".format(bucket))
+            with open(path, "w", encoding="utf-8") as f:
+                for r in rows:
+                    f.write(r + "\n")
+            return {path: len(rows)}
+        path = os.path.join(self.out_dir, "part.{}.parquet".format(bucket))
+        table = pa.table({"sentences": rows},
+                         schema=pa.schema([("sentences", pa.string())]))
+        pq.write_table(table, path)
         return {path: len(rows)}
-    path = os.path.join(out_dir, "part.{}.parquet".format(bucket))
-    table = pa.table({"sentences": rows},
-                     schema=pa.schema([("sentences", pa.string())]))
-    pq.write_table(table, path)
-    return {path: len(rows)}
 
 
 def run_bart_preprocess(
@@ -92,6 +102,7 @@ def run_bart_preprocess(
     output_format="parquet",
     comm=None,
     log=None,
+    num_workers=1,
 ):
     """Run the BART preprocessing pipeline (SPMD contract per
     run_sharded_pipeline). Output: part.<k>.parquet with a single
@@ -102,12 +113,12 @@ def run_bart_preprocess(
     return run_sharded_pipeline(
         corpus_paths,
         out_dir,
-        lambda texts, bucket: _process_bucket(
-            texts, bucket, config, seed, out_dir, output_format),
+        BartBucketProcessor(config, seed, out_dir, output_format),
         num_blocks=num_blocks,
         sample_ratio=sample_ratio,
         seed=seed,
         global_shuffle=global_shuffle,
         comm=comm,
         log=log,
+        num_workers=num_workers,
     )

@@ -60,30 +60,30 @@ def vocab_words_of(tokenizer):
             if t not in specials]
 
 
-def _scatter_phase(blocks, out_dir, comm, sample_ratio, seed, nbuckets, log):
-    """Phase 1: read my blocks, spool each doc to its hash bucket."""
+def _spool_one_block(block, out_dir, seed, sample_ratio, nbuckets):
+    """Scatter one input block: append every doc to its hash bucket's spool
+    file. Each block writes its own per-bucket files, so blocks can spool
+    concurrently (across ranks and across pool workers) without locking."""
     spool_root = os.path.join(out_dir, _SPOOL_DIR)
-    for block in blocks[comm.rank::comm.world_size]:
-        sinks = {}
-        try:
-            for ordinal, (doc_id, text) in enumerate(
-                    read_documents(block, sample_ratio=sample_ratio,
-                                   base_seed=seed)):
-                b = _bucket_of(seed, block.block_id, ordinal, nbuckets)
-                sink = sinks.get(b)
-                if sink is None:
-                    bucket_dir = os.path.join(spool_root, "bucket-{}".format(b))
-                    os.makedirs(bucket_dir, exist_ok=True)
-                    sink = open(
-                        os.path.join(bucket_dir,
-                                     "block-{}.txt".format(block.block_id)),
-                        "w", encoding="utf-8")
-                    sinks[b] = sink
-                sink.write(doc_id + " " + text + "\n")
-        finally:
-            for sink in sinks.values():
-                sink.close()
-    log("rank {}: scatter phase done".format(comm.rank))
+    sinks = {}
+    try:
+        for ordinal, (doc_id, text) in enumerate(
+                read_documents(block, sample_ratio=sample_ratio,
+                               base_seed=seed)):
+            b = _bucket_of(seed, block.block_id, ordinal, nbuckets)
+            sink = sinks.get(b)
+            if sink is None:
+                bucket_dir = os.path.join(spool_root, "bucket-{}".format(b))
+                os.makedirs(bucket_dir, exist_ok=True)
+                sink = open(
+                    os.path.join(bucket_dir,
+                                 "block-{}.txt".format(block.block_id)),
+                    "w", encoding="utf-8")
+                sinks[b] = sink
+            sink.write(doc_id + " " + text + "\n")
+    finally:
+        for sink in sinks.values():
+            sink.close()
 
 
 def _read_bucket_docs(out_dir, bucket):
@@ -103,18 +103,52 @@ def _read_bucket_docs(out_dir, bucket):
     return texts
 
 
-def _process_bucket(texts, bucket, tok_info, config, seed, out_dir, bin_size,
-                    output_format):
-    g = lrng.sample_rng(seed, 0x9A1A, bucket)
-    lrng.shuffle(g, texts)
-    batch = instances_from_texts(texts, tok_info, config, seed, bucket)
-    rows = materialize_rows(batch, config, tok_info, seed, (0x3A5C, bucket))
-    if output_format == "txt":
-        return _write_txt_shard(rows, out_dir, bucket, config.masking,
-                                bin_size, config.max_seq_length)
-    return binning_mod.write_shard(
-        rows, out_dir, bucket, masking=config.masking, bin_size=bin_size,
-        target_seq_length=config.max_seq_length)
+class BertBucketProcessor:
+    """Picklable per-bucket BERT pipeline stage: shuffle -> instances ->
+    materialize -> shard sink. Pickles the HF tokenizer (fast tokenizers
+    serialize to their JSON form); the TokenizerInfo tables and native
+    engine are rebuilt lazily once per process."""
+
+    def __init__(self, tokenizer, config, seed, out_dir, bin_size,
+                 output_format):
+        self.tokenizer = tokenizer
+        self.config = config
+        self.seed = seed
+        self.out_dir = out_dir
+        self.bin_size = bin_size
+        self.output_format = output_format
+        self._tok_info = None
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_tok_info"] = None  # rebuilt per process
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    @property
+    def tok_info(self):
+        if self._tok_info is None:
+            self._tok_info = TokenizerInfo(self.tokenizer)
+        return self._tok_info
+
+    def __call__(self, texts, bucket):
+        config, seed = self.config, self.seed
+        g = lrng.sample_rng(seed, 0x9A1A, bucket)
+        lrng.shuffle(g, texts)
+        batch = instances_from_texts(texts, self.tok_info, config, seed,
+                                     bucket)
+        rows = materialize_rows(batch, config, self.tok_info, seed,
+                                (0x3A5C, bucket))
+        if self.output_format == "txt":
+            return _write_txt_shard(rows, self.out_dir, bucket,
+                                    config.masking, self.bin_size,
+                                    config.max_seq_length)
+        return binning_mod.write_shard(
+            rows, self.out_dir, bucket, masking=config.masking,
+            bin_size=self.bin_size,
+            target_seq_length=config.max_seq_length)
 
 
 def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
@@ -155,6 +189,42 @@ def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
     return written
 
 
+# Worker-process globals for the intra-host pool (set by _pool_init).
+_POOL = {}
+
+
+def _pool_init(process_bucket, spec):
+    _POOL["process_bucket"] = process_bucket
+    _POOL["spec"] = spec
+
+
+def _bucket_texts(spec, bucket):
+    """Load one bucket's documents inside a worker (texts never cross the
+    process boundary; workers re-read from the spool / re-plan blocks
+    deterministically)."""
+    if spec["global_shuffle"]:
+        return _read_bucket_docs(spec["out_dir"], bucket)
+    input_files = discover_source_files(spec["corpus_paths"])
+    blocks = plan_blocks(input_files, spec["num_blocks"])
+    return [text for _, text in read_documents(
+        blocks[bucket], sample_ratio=spec["sample_ratio"],
+        base_seed=spec["seed"])]
+
+
+def _pool_run_bucket(bucket):
+    texts = _bucket_texts(_POOL["spec"], bucket)
+    return _POOL["process_bucket"](texts, bucket)
+
+
+def _pool_scatter_block(block_id):
+    spec = _POOL["spec"]
+    input_files = discover_source_files(spec["corpus_paths"])
+    blocks = plan_blocks(input_files, spec["num_blocks"])
+    _spool_one_block(blocks[block_id], spec["out_dir"], spec["seed"],
+                     spec["sample_ratio"], len(blocks))
+    return block_id
+
+
 def run_sharded_pipeline(
     corpus_paths,
     out_dir,
@@ -165,6 +235,7 @@ def run_sharded_pipeline(
     global_shuffle=True,
     comm=None,
     log=None,
+    num_workers=1,
 ):
     """Generic SPMD scaffolding shared by every preprocessor: dirty-dir
     guard -> block planning -> (optional) scatter shuffle -> strided bucket
@@ -202,20 +273,60 @@ def run_sharded_pipeline(
     nbuckets = len(blocks)
     log("{} input files -> {} blocks".format(len(input_files), len(blocks)))
 
-    if global_shuffle:
-        _scatter_phase(blocks, out_dir, comm, sample_ratio, seed, nbuckets, log)
-        comm.barrier()
+    # Intra-host fan-out (the reference runs ~128 MPI ranks per node,
+    # slurm_example.sub:72; our equivalent is one Communicator rank per
+    # host times a local spawn pool). Workers re-read inputs themselves —
+    # only bucket ids cross the process boundary.
+    my_buckets = list(range(comm.rank, nbuckets, comm.world_size))
+    workers = max(1, int(num_workers or 1))
+    pool = None
+    if workers > 1 and len(my_buckets) > 1:
+        import concurrent.futures
+        import multiprocessing
+        spec = {
+            "global_shuffle": global_shuffle,
+            "out_dir": out_dir,
+            "corpus_paths": corpus_paths,
+            "num_blocks": num_blocks,
+            "sample_ratio": sample_ratio,
+            "seed": seed,
+        }
+        pool = concurrent.futures.ProcessPoolExecutor(
+            max_workers=min(workers, len(my_buckets)),
+            mp_context=multiprocessing.get_context("spawn"),
+            initializer=_pool_init,
+            initargs=(process_bucket, spec))
 
-    written = {}
-    for bucket in range(comm.rank, nbuckets, comm.world_size):
+    try:
         if global_shuffle:
-            texts = _read_bucket_docs(out_dir, bucket)
+            my_blocks = list(range(comm.rank, len(blocks), comm.world_size))
+            if pool is not None:
+                list(pool.map(_pool_scatter_block, my_blocks))
+            else:
+                for b in my_blocks:
+                    _spool_one_block(blocks[b], out_dir, seed, sample_ratio,
+                                     nbuckets)
+            log("rank {}: scatter phase done".format(comm.rank))
+            comm.barrier()
+
+        written = {}
+        if pool is not None:
+            for res in pool.map(_pool_run_bucket, my_buckets):
+                written.update(res)
         else:
-            texts = [
-                text for _, text in read_documents(
-                    blocks[bucket], sample_ratio=sample_ratio, base_seed=seed)
-            ]
-        written.update(process_bucket(texts, bucket))
+            for bucket in my_buckets:
+                if global_shuffle:
+                    texts = _read_bucket_docs(out_dir, bucket)
+                else:
+                    texts = [
+                        text for _, text in read_documents(
+                            blocks[bucket], sample_ratio=sample_ratio,
+                            base_seed=seed)
+                    ]
+                written.update(process_bucket(texts, bucket))
+    finally:
+        if pool is not None:
+            pool.shutdown()
     comm.barrier()
 
     if global_shuffle and comm.rank == 0:
@@ -239,26 +350,27 @@ def run_bert_preprocess(
     output_format="parquet",
     comm=None,
     log=None,
+    num_workers=1,
 ):
     """Run the full BERT preprocessing pipeline (see run_sharded_pipeline
-    for the SPMD execution contract)."""
+    for the SPMD execution contract). ``num_workers`` > 1 fans the bucket
+    work out over a local process pool per host."""
     config = config or BertPretrainConfig()
     if output_format not in ("parquet", "txt"):
         raise ValueError("output_format must be parquet|txt")
     if bin_size is not None:
         binning_mod.num_bins(config.max_seq_length, bin_size)  # validate
-    tok_info = TokenizerInfo(tokenizer)
 
     return run_sharded_pipeline(
         corpus_paths,
         out_dir,
-        lambda texts, bucket: _process_bucket(
-            texts, bucket, tok_info, config, seed, out_dir, bin_size,
-            output_format),
+        BertBucketProcessor(tokenizer, config, seed, out_dir, bin_size,
+                            output_format),
         num_blocks=num_blocks,
         sample_ratio=sample_ratio,
         seed=seed,
         global_shuffle=global_shuffle,
         comm=comm,
         log=log,
+        num_workers=num_workers,
     )

@@ -232,6 +232,32 @@ def test_e2e_multirank_matches_single_rank(tiny_corpus, tokenizer, tmp_path):
         assert pq.read_table(a).equals(pq.read_table(b))
 
 
+def test_e2e_pool_matches_sequential(tiny_corpus, tokenizer, tmp_path):
+    """num_workers>1 (spawn process pool) writes exactly the same shards
+    as the sequential path — bucket work is side-effect-isolated and
+    deterministic, so fan-out must be invisible in the output."""
+    cfg = dict(
+        config=BertPretrainConfig(max_seq_length=32, duplicate_factor=1,
+                                  masking=True),
+        num_blocks=4, sample_ratio=1.0, seed=0, bin_size=8)
+
+    out1 = str(tmp_path / "seq")
+    run_bert_preprocess({"wikipedia": tiny_corpus}, out1, tokenizer, **cfg)
+
+    out2 = str(tmp_path / "pool")
+    run_bert_preprocess({"wikipedia": tiny_corpus}, out2, tokenizer,
+                        num_workers=2, **cfg)
+
+    import pyarrow.parquet as pq
+    p1 = get_all_parquets_under(out1)
+    p2 = get_all_parquets_under(out2)
+    assert [os.path.basename(p) for p in p1] == [
+        os.path.basename(p) for p in p2]
+    assert len(p1) > 1
+    for a, b in zip(p1, p2):
+        assert pq.read_table(a).equals(pq.read_table(b))
+
+
 def test_txt_output(tiny_corpus, tokenizer, tmp_path):
     out = str(tmp_path / "out")
     written = run_bert_preprocess(
