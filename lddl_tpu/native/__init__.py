@@ -125,10 +125,16 @@ class NativeTokenizer:
         lib = _load()
         if lib is None:
             raise RuntimeError("native engine unavailable")
+        self._args = (list(id_to_token), int(unk_id), bool(do_lower_case))
         self._lib = lib
         buf = "\n".join(id_to_token).encode("utf-8")
         self._handle = lib.lddl_tok_create(buf, len(buf), int(unk_id),
                                            1 if do_lower_case else 0)
+
+    def __reduce__(self):
+        # ctypes handles cannot cross pickle boundaries; rebuild from the
+        # constructor args in the receiving process (fresh memo cache).
+        return (NativeTokenizer, self._args)
 
     def __del__(self):
         if getattr(self, "_handle", None):

@@ -88,6 +88,29 @@ class TokenizerInfo:
         self.is_subword = np.array(
             [t.startswith("##") for t in self.id_to_token], dtype=bool)
 
+    def __getstate__(self):
+        # The native engine holds a ctypes CDLL handle, which cannot cross
+        # a pickle boundary (process pools pickle the tokenizer, and this
+        # object may be cached on it). Every table here derives from the
+        # tokenizer, so ship only that and re-derive on the other side —
+        # otherwise each worker-spawn pickle would carry the vocab several
+        # times over.
+        return {"tokenizer": self.tokenizer}
+
+    def __setstate__(self, state):
+        # Re-derivation is deferred to first attribute use: this object may
+        # sit in a reference cycle with the tokenizer (the
+        # ``_lddl_tpu_tok_info`` cache), so the tokenizer is not fully
+        # restored yet when __setstate__ runs.
+        self.__dict__["_pickled_tokenizer"] = state["tokenizer"]
+
+    def __getattr__(self, name):
+        tok = self.__dict__.pop("_pickled_tokenizer", None)
+        if tok is None:
+            raise AttributeError(name)
+        self.__init__(tok)
+        return getattr(self, name)
+
     def join(self, ids):
         return " ".join(self.id_to_token[np.asarray(ids, dtype=np.int64)])
 
@@ -454,24 +477,40 @@ def apply_static_masking(batch, config, tok_info, seed, scope):
                                             lrng.sample_rng(seed, *scope))
     elif config.engine == "jax":
         masker = _get_jax_masker(tok_info)
-        # Pad the batch dim to a bucket as well: jit keys compilations on
-        # the full shape, and every bucket has a different row count.
+        # jit keys compilations on the full shape and every bucket has a
+        # different row count, so run in fixed-size row chunks: all full
+        # chunks share ONE compiled shape per width bucket; only the last
+        # partial chunk pads up to a power of two (floor 64). Compilation
+        # count stays O(log chunk) per width, padding waste stays small.
         n = ids.shape[0]
-        n_pad = max(512, 1 << (n - 1).bit_length())
-        if n_pad > n:
-            pad_rows = n_pad - n
-            ids_p = np.pad(ids, ((0, pad_rows), (0, 0)))
-            cand_p = np.pad(candidate, ((0, pad_rows), (0, 0)))
-            num_p = np.pad(num_to_predict, (0, pad_rows))
-        else:
-            ids_p, cand_p, num_p = ids, candidate, num_to_predict
-        # Fold the scope into a 32-bit seed for jax.random.
+        chunk = 2048
+        # Fold the scope into a 32-bit seed for jax.random; vary per chunk
+        # so chunking does not correlate the streams.
         import hashlib
-        h = hashlib.blake2b(
-            ("{}:{}".format(seed, scope)).encode(), digest_size=4).digest()
-        masked, selected = masker(ids_p, cand_p, num_p,
-                                  int.from_bytes(h, "little"))
-        masked, selected = masked[:n], selected[:n]
+
+        def _seed_of(ci):
+            h = hashlib.blake2b(
+                ("{}:{}:{}".format(seed, scope, ci)).encode(),
+                digest_size=4).digest()
+            return int.from_bytes(h, "little")
+
+        masked_parts, selected_parts = [], []
+        for ci, start in enumerate(range(0, n, chunk)):
+            ids_c = ids[start:start + chunk]
+            cand_c = candidate[start:start + chunk]
+            num_c = num_to_predict[start:start + chunk]
+            nc = ids_c.shape[0]
+            n_pad = min(chunk, 1 << max(6, (nc - 1).bit_length()))
+            if n_pad > nc:
+                ids_c = np.pad(ids_c, ((0, n_pad - nc), (0, 0)))
+                cand_c = np.pad(cand_c, ((0, n_pad - nc), (0, 0)))
+                num_c = np.pad(num_c, (0, n_pad - nc))
+            m_c, s_c = masker(ids_c, cand_c, num_c, _seed_of(ci))
+            masked_parts.append(np.asarray(m_c[:nc]))
+            selected_parts.append(np.asarray(s_c[:nc]))
+        masked = np.concatenate(masked_parts) if masked_parts else ids
+        selected = (np.concatenate(selected_parts)
+                    if selected_parts else np.zeros_like(candidate))
     else:
         masked, selected = mask_batch_numpy(
             ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),

@@ -258,6 +258,42 @@ def test_e2e_pool_matches_sequential(tiny_corpus, tokenizer, tmp_path):
         assert pq.read_table(a).equals(pq.read_table(b))
 
 
+def test_tokenizer_picklable_after_native_use(tokenizer):
+    """Regression: documents_from_texts caches a TokenizerInfo (holding the
+    ctypes-backed native engine) on the tokenizer; the tokenizer — and the
+    cached info — must still pickle afterwards, or any num_workers>1 run
+    whose parent touched the tokenizer first would crash at pool spawn."""
+    import pickle
+
+    docs = documents_from_texts(["alpha beta. gamma delta."], tokenizer)
+    assert docs
+    info = getattr(tokenizer, "_lddl_tpu_tok_info", None)
+    tok2 = pickle.loads(pickle.dumps(tokenizer))
+    if info is not None:
+        info2 = pickle.loads(pickle.dumps(info))
+        # The rebuilt info must lazily reconstruct a working engine.
+        docs2 = documents_from_texts(["alpha beta. gamma delta."], info2)
+        assert docs2 == docs
+    assert documents_from_texts(["alpha beta. gamma delta."], tok2) == docs
+
+
+def test_native_tokenizer_pickle_roundtrip(tokenizer):
+    from lddl_tpu import native
+    import pickle
+
+    if not native.available():
+        pytest.skip("native engine unavailable")
+    info = TokenizerInfo(tokenizer)
+    nat = info.native_tokenizer()
+    if nat is None:
+        pytest.skip("native engine incompatible with tokenizer")
+    nat2 = pickle.loads(pickle.dumps(nat))
+    a = nat.tokenize_docs(["alpha beta. gamma delta."])
+    b = nat2.tokenize_docs(["alpha beta. gamma delta."])
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
 def test_txt_output(tiny_corpus, tokenizer, tmp_path):
     out = str(tmp_path / "out")
     written = run_bert_preprocess(
