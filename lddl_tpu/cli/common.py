@@ -29,16 +29,50 @@ def corpus_paths_of(args):
 def attach_multihost_arg(parser):
     parser.add_argument(
         "--multihost", action="store_true",
-        help="initialize jax.distributed (reads the standard "
-             "JAX coordinator env vars / TPU metadata) and split work "
-             "across hosts")
+        help="initialize jax.distributed and split work across hosts; "
+             "without the flags below, coordinator/rank come from the "
+             "cluster environment (TPU metadata, SLURM, ...)")
+    parser.add_argument(
+        "--coordinator-address", default=None, metavar="HOST:PORT",
+        help="rank-0 coordinator address when no cluster env provides it "
+             "(the jax.distributed equivalent of mpirun's wiring)")
+    parser.add_argument("--num-processes", type=int, default=None,
+                        help="world size (with --coordinator-address)")
+    parser.add_argument("--process-id", type=int, default=None,
+                        help="this host's rank (with --coordinator-address)")
 
 
 def communicator_of(args):
     from ..parallel.distributed import get_communicator
     if getattr(args, "multihost", False):
+        import os
+
         import jax
-        jax.distributed.initialize()
+        plats = os.environ.get("JAX_PLATFORMS", "")
+        if plats:
+            # Re-assert the env var through the config: if anything imported
+            # jax and touched a backend before us (e.g. a site hook), the
+            # env var alone no longer takes effect, and a half-initialized
+            # accelerator backend would silently break collective semantics.
+            jax.config.update("jax_platforms", plats)
+        if plats.startswith("cpu"):
+            # CPU-only preprocess clusters (no TPUs attached) need an
+            # explicit cross-process collectives backend; TPU pods get
+            # collectives from the platform itself.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        wiring = (args.coordinator_address, args.num_processes,
+                  args.process_id)
+        if any(v is not None for v in wiring) and None in wiring:
+            raise SystemExit(
+                "--coordinator-address, --num-processes and --process-id "
+                "must be given together (or none, for cluster "
+                "auto-detection)")
+        kwargs = {}
+        if args.coordinator_address is not None:
+            kwargs = dict(coordinator_address=args.coordinator_address,
+                          num_processes=args.num_processes,
+                          process_id=args.process_id)
+        jax.distributed.initialize(**kwargs)
     return get_communicator()
 
 

@@ -1,0 +1,38 @@
+"""Subprocess body for test_jax_communicator_collectives: exercises
+JaxCommunicator (rank/world/barrier/allreduce) over a real 2-process
+jax.distributed group on the CPU backend."""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    rank, world, coordinator = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world, process_id=rank)
+
+    from lddl_tpu.parallel.distributed import (JaxCommunicator,
+                                               get_communicator)
+    comm = get_communicator()
+    assert isinstance(comm, JaxCommunicator), type(comm)
+    assert comm.rank == rank and comm.world_size == world
+
+    # int64 above 2^31: the payload must survive jax's int32
+    # canonicalization (shipped as raw bytes, reduced on host).
+    big = 3_000_000_000
+    total = comm.allreduce_sum([big + rank, rank, 1])
+    assert total.tolist() == [2 * big + sum(range(world)),
+                              sum(range(world)), world], total
+    mx = comm.allreduce_max([big + rank, rank])
+    assert mx.tolist() == [big + world - 1, world - 1], mx
+    comm.barrier()
+    print("COLLECTIVES_OK")
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
