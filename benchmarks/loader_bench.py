@@ -1,0 +1,142 @@
+"""Loader throughput benchmark: records samples/s + ms/batch to the repo.
+
+Builds a Zipf corpus with bench.make_corpus (the adversarial generator the
+preprocessing benchmark uses), preprocesses it twice (binned+static and
+unbinned+dynamic), balances, then runs benchmarks/mock_train.py as a
+subprocess per configuration — the measured numbers are exactly what the
+reference-style harness prints (ref: benchmarks/torch_train.py:188-199).
+
+Writes LOADER_BENCH.json at the repo root:
+    {"configs": {name: {"samples_per_s": .., "ms_per_batch": ..,
+                        "pad_ratio": ..}}, ...}
+
+Usage: python benchmarks/loader_bench.py [--mb 8] [--out LOADER_BENCH.json]
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def _build_dataset(tmp, mb):
+    from bench import make_corpus
+    from lddl_tpu.preprocess import (BertPretrainConfig, build_wordpiece_vocab,
+                                     get_tokenizer, run_bert_preprocess)
+    from lddl_tpu.balance import balance_shards
+
+    corpus = os.path.join(tmp, "corpus")
+    make_corpus(corpus, mb, seed=0)
+    sample = []
+    sample_bytes = 0
+    with open(os.path.join(corpus, "source", "0.txt"), encoding="utf-8") as f:
+        for line in f:
+            sample.append(line.split(None, 1)[1])
+            sample_bytes += len(line)
+            if sample_bytes > 1_000_000:
+                break
+    vocab = build_wordpiece_vocab(sample, os.path.join(tmp, "vocab.txt"),
+                                  vocab_size=30522)
+    tok = get_tokenizer(vocab_file=vocab)
+
+    datasets = {}
+    for name, masking, bin_size in (("static_binned", True, 32),
+                                    ("dynamic_unbinned", False, None)):
+        pre = os.path.join(tmp, "pre_" + name)
+        bal = os.path.join(tmp, "bal_" + name)
+        run_bert_preprocess(
+            {"wikipedia": corpus}, pre, tok,
+            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
+                                      masking=masking),
+            num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=bin_size,
+            num_workers=os.cpu_count())
+        balance_shards(pre, bal, 8)
+        datasets[name] = bal
+    return datasets, vocab
+
+
+_THROUGHPUT_RE = re.compile(
+    r"loader throughput: ([\d.]+) samples/s avg, ([\d.]+) ms/batch avg")
+_SUSTAINED_RE = re.compile(r"loader sustained: ([\d.]+) samples/s")
+_PAD_RE = re.compile(r"padded-zero ratio: ([\d.]+)")
+_STEP_RE = re.compile(r"train step: ([\d.]+) ms avg")
+
+
+def _run_mock_train(path, vocab, extra):
+    cmd = [sys.executable, os.path.join(ROOT, "benchmarks", "mock_train.py"),
+           "--path", path, "--vocab-file", vocab, "--epochs", "2",
+           "--log-freq", "1000000"] + extra
+    proc = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT)
+    if proc.returncode != 0:
+        raise RuntimeError("mock_train failed ({}):\n{}".format(
+            proc.returncode, proc.stderr[-4000:]))
+    out = proc.stdout
+    m = _THROUGHPUT_RE.search(out)
+    result = {"samples_per_s": float(m.group(1)),
+              "ms_per_batch": float(m.group(2)),
+              "sustained_samples_per_s": float(
+                  _SUSTAINED_RE.search(out).group(1))}
+    m = _PAD_RE.search(out)
+    if m:
+        result["pad_ratio"] = float(m.group(1))
+    m = _STEP_RE.search(out)
+    if m:
+        result["train_step_ms"] = float(m.group(1))
+    return result
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mb", type=float, default=8.0)
+    p.add_argument("--out", default=os.path.join(ROOT, "LOADER_BENCH.json"))
+    p.add_argument("--with-model", action="store_true",
+                   help="also measure with a jitted tiny-BERT train step")
+    args = p.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="lddl_loader_bench_")
+    try:
+        datasets, vocab = _build_dataset(tmp, args.mb)
+        configs = {
+            "dynamic_unbinned_w1": (datasets["dynamic_unbinned"],
+                                    ["--num-workers", "1"]),
+            "dynamic_unbinned_w4": (datasets["dynamic_unbinned"],
+                                    ["--num-workers", "4"]),
+            "static_binned_w1": (datasets["static_binned"],
+                                 ["--num-workers", "1"]),
+            "static_binned_w4": (datasets["static_binned"],
+                                 ["--num-workers", "4"]),
+        }
+        if args.with_model:
+            configs["static_binned_w4_model"] = (
+                datasets["static_binned"],
+                ["--num-workers", "4", "--with-model", "tiny",
+                 "--fixed-seq-lengths", "32", "64", "96", "128"])
+        results = {}
+        for name, (path, extra) in configs.items():
+            results[name] = _run_mock_train(path, vocab, extra)
+            print(name, results[name], flush=True)
+            payload = {
+                "unit": "samples/s (loader-only wall clock incl. decode, "
+                        "shuffle buffer, collate, dynamic masking)",
+                "corpus_mb": args.mb,
+                "batch_size": 64,
+                "cpu_count": os.cpu_count(),
+                "configs": results,
+            }
+            # Written incrementally so a late-config crash keeps the rest.
+            with open(args.out, "w") as f:
+                json.dump(payload, f, indent=1)
+        print("wrote", args.out)
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -167,8 +167,12 @@ def main():
     pad_hist = Histogram()
     all_min_lens, all_max_lens, all_batch_lens = [], [], []
     step_time = AverageMeter(warmup=2)
+    total_samples = 0
+    total_wall = 0.0
 
     for epoch in range(args.start_epoch, args.start_epoch + args.epochs):
+        epoch_t0 = time.perf_counter()
+        epoch_samples = 0
         t0 = time.perf_counter()
         for i, batch in enumerate(loader):
             n, L = batch["input_ids"].shape
@@ -191,16 +195,24 @@ def main():
             dt = time.perf_counter() - t0
             batch_time.update(dt)
             throughput.update(n / dt)
+            epoch_samples += n
             if (i + 1) % args.log_freq == 0:
                 print("epoch {} it {}: {:.1f} samples/s, {:.2f} ms/batch"
                       .format(epoch, i + 1, throughput.avg,
                               batch_time.avg * 1e3))
             t0 = time.perf_counter()
+        total_samples += epoch_samples
+        total_wall += time.perf_counter() - epoch_t0
 
     total_tokens = sum(k * v for k, v in seq_len_hist.counts.items())
     total_pad = sum(pad_hist.counts.values())
     print("loader throughput: {:.1f} samples/s avg, {:.2f} ms/batch avg"
           .format(throughput.avg, batch_time.avg * 1e3))
+    # Per-batch rate averages overstate sustained speed once prefetch hides
+    # batches behind consumption; samples over wall clock is the honest one.
+    print("loader sustained: {:.1f} samples/s ({} samples / {:.2f} s)"
+          .format(total_samples / max(total_wall, 1e-9), total_samples,
+                  total_wall))
     if step is not None:
         print("train step: {:.2f} ms avg on mesh {}".format(
             step_time.avg * 1e3, dict(mesh.shape)))

@@ -69,6 +69,12 @@ class BertCollate:
         self._cls_id = tokenizer.convert_tokens_to_ids("[CLS]")
         self._sep_id = tokenizer.convert_tokens_to_ids("[SEP]")
         self._vocab_size = len(tokenizer)
+        # One dict lookup per token beats a per-sample HF call; shards store
+        # tokens this tokenizer produced, so misses (-> unk) are impossible
+        # in practice but keep convert_tokens_to_ids semantics anyway.
+        self._vocab = dict(tokenizer.get_vocab())
+        self._unk_id = tokenizer.convert_tokens_to_ids(
+            tokenizer.unk_token or "[UNK]")
 
     def _batch_seq_len(self, lens):
         longest = max(lens)
@@ -81,42 +87,78 @@ class BertCollate:
         from ..ops.packing import round_up
         return round_up(longest, self._align)
 
+    def _token_ids_and_lens(self, texts):
+        """One flat id array + per-text lengths for a list of space-joined
+        token strings (single pass, dict lookups only)."""
+        token_lists = [t.split() for t in texts]
+        lens = np.fromiter((len(t) for t in token_lists), dtype=np.int64,
+                           count=len(token_lists))
+        vocab_get = self._vocab.get
+        unk = self._unk_id
+        flat = np.fromiter(
+            (vocab_get(t, unk) for ts in token_lists for t in ts),
+            dtype=np.int32, count=int(lens.sum()))
+        return flat, lens
+
+    @staticmethod
+    def _concat_aranges(lens):
+        """[arange(l) for l in lens] concatenated, without a Python loop."""
+        total = int(lens.sum())
+        if total == 0:
+            return np.zeros(0, dtype=np.int64)
+        starts = np.cumsum(lens) - lens
+        return np.arange(total, dtype=np.int64) - np.repeat(starts, lens)
+
     def __call__(self, samples, g=None):
         n = len(samples)
         static = len(samples[0]) == 5
-        tok = self._tokenizer
-        a_ids = [tok.convert_tokens_to_ids(s[0].split()) for s in samples]
-        b_ids = [tok.convert_tokens_to_ids(s[1].split()) for s in samples]
-        seq_len = self._batch_seq_len(
-            [len(a) + len(b) + 3 for a, b in zip(a_ids, b_ids)])
+        flat_a, lens_a = self._token_ids_and_lens([s[0] for s in samples])
+        flat_b, lens_b = self._token_ids_and_lens([s[1] for s in samples])
+        ends = lens_a + lens_b + 3
+        seq_len = self._batch_seq_len([int(ends.max())])
+
+        rows = np.arange(n, dtype=np.int64)
+        col = np.arange(seq_len, dtype=np.int64)[None, :]
+        # Flat scatter of the A and B segments: row offsets repeated per
+        # token + a concatenated per-row arange gives every target slot.
+        idx_a = (np.repeat(rows, lens_a) * seq_len
+                 + 1 + self._concat_aranges(lens_a))
+        idx_b = (np.repeat(rows * seq_len + 2 + lens_a, lens_b)
+                 + self._concat_aranges(lens_b))
 
         input_ids = np.zeros((n, seq_len), dtype=np.int32)
-        token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
-        attention_mask = np.zeros((n, seq_len), dtype=np.int32)
-        special_tokens_mask = np.ones((n, seq_len), dtype=bool)
+        input_ids[:, 0] = self._cls_id
+        input_ids.flat[idx_a] = flat_a
+        input_ids.flat[idx_b] = flat_b
+        input_ids[rows, 1 + lens_a] = self._sep_id
+        input_ids[rows, ends - 1] = self._sep_id
+
+        token_type_ids = ((col >= (2 + lens_a)[:, None])
+                          & (col < ends[:, None])).astype(np.int32)
+        attention_mask = (col < ends[:, None]).astype(np.int32)
+
         labels = np.full((n, seq_len), self._ignore_index, dtype=np.int32)
-
-        for i, (a, b) in enumerate(zip(a_ids, b_ids)):
-            la, lb = len(a), len(b)
-            end = la + lb + 3
-            input_ids[i, 0] = self._cls_id
-            input_ids[i, 1:1 + la] = a
-            input_ids[i, 1 + la] = self._sep_id
-            input_ids[i, 2 + la:2 + la + lb] = b
-            input_ids[i, end - 1] = self._sep_id
-            token_type_ids[i, 2 + la:end] = 1
-            attention_mask[i, :end] = 1
-            # Non-special positions eligible for masking.
-            special_tokens_mask[i, 1:1 + la] = False
-            special_tokens_mask[i, 2 + la:end - 1] = False
-            if static:
-                positions = deserialize_np_array(samples[i][3]).astype(np.int64)
-                label_ids = tok.convert_tokens_to_ids(samples[i][4].split())
-                labels[i, positions] = np.asarray(label_ids, dtype=np.int32)
-
-        if not static:
+        if static:
+            pos_list = [deserialize_np_array(s[3]).astype(np.int64)
+                        for s in samples]
+            flat_labels, lens_m = self._token_ids_and_lens(
+                [s[4] for s in samples])
+            pos_lens = np.fromiter(map(len, pos_list), dtype=np.int64,
+                                   count=n)
+            if not np.array_equal(pos_lens, lens_m):
+                raise ValueError(
+                    "masked_lm_positions/masked_lm_labels length mismatch "
+                    "in sample(s) {}".format(
+                        np.flatnonzero(pos_lens != lens_m).tolist()))
+            labels[np.repeat(rows, lens_m),
+                   np.concatenate(pos_list)] = flat_labels
+        else:
             if g is None:
                 raise ValueError("dynamic masking needs a worker RNG")
+            # Non-special positions eligible for masking.
+            special_tokens_mask = np.ones((n, seq_len), dtype=bool)
+            special_tokens_mask.flat[idx_a] = False
+            special_tokens_mask.flat[idx_b] = False
             input_ids, labels = self._mask_tokens(
                 input_ids, special_tokens_mask, g)
 

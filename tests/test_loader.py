@@ -253,3 +253,107 @@ def test_loader_validation(pipeline):
     with pytest.raises(ValueError):
         get_bert_pretrain_data_loader(
             "/nonexistent", vocab_file=pipeline["vocab"])
+
+
+def _reference_collate(tok, samples, seq_len, ignore_index=-1):
+    """Per-row loop encoding (the pre-vectorization implementation) used as
+    the parity oracle for BertCollate's scatter-based encode."""
+    n = len(samples)
+    static = len(samples[0]) == 5
+    from lddl_tpu.utils.fs import deserialize_np_array
+    cls_id = tok.convert_tokens_to_ids("[CLS]")
+    sep_id = tok.convert_tokens_to_ids("[SEP]")
+    a_ids = [tok.convert_tokens_to_ids(s[0].split()) for s in samples]
+    b_ids = [tok.convert_tokens_to_ids(s[1].split()) for s in samples]
+    input_ids = np.zeros((n, seq_len), dtype=np.int32)
+    token_type_ids = np.zeros((n, seq_len), dtype=np.int32)
+    attention_mask = np.zeros((n, seq_len), dtype=np.int32)
+    special_tokens_mask = np.ones((n, seq_len), dtype=bool)
+    labels = np.full((n, seq_len), ignore_index, dtype=np.int32)
+    for i, (a, b) in enumerate(zip(a_ids, b_ids)):
+        la, lb = len(a), len(b)
+        end = la + lb + 3
+        input_ids[i, 0] = cls_id
+        input_ids[i, 1:1 + la] = a
+        input_ids[i, 1 + la] = sep_id
+        input_ids[i, 2 + la:2 + la + lb] = b
+        input_ids[i, end - 1] = sep_id
+        token_type_ids[i, 2 + la:end] = 1
+        attention_mask[i, :end] = 1
+        special_tokens_mask[i, 1:1 + la] = False
+        special_tokens_mask[i, 2 + la:end - 1] = False
+        if static:
+            positions = deserialize_np_array(samples[i][3]).astype(np.int64)
+            label_ids = tok.convert_tokens_to_ids(samples[i][4].split())
+            labels[i, positions] = np.asarray(label_ids, dtype=np.int32)
+    return (input_ids, token_type_ids, attention_mask, special_tokens_mask,
+            labels)
+
+
+def _synthetic_samples(tok, n, static, seed=7):
+    g = np.random.Generator(np.random.Philox(key=[0, seed]))
+    vocab_tokens = [t for t in tok.get_vocab() if not t.startswith("[")]
+    samples = []
+    from lddl_tpu.utils.fs import serialize_np_array
+    for _ in range(n):
+        la, lb = int(g.integers(1, 20)), int(g.integers(1, 20))
+        a = " ".join(vocab_tokens[int(g.integers(0, len(vocab_tokens)))]
+                     for _ in range(la))
+        b = " ".join(vocab_tokens[int(g.integers(0, len(vocab_tokens)))]
+                     for _ in range(lb))
+        rn = int(g.integers(0, 2))
+        if static:
+            k = int(g.integers(0, min(5, la + lb + 2)))
+            pos = np.sort(g.choice(np.arange(1, la + lb + 2), size=k,
+                                   replace=False)).astype(np.uint16)
+            labs = " ".join(
+                vocab_tokens[int(g.integers(0, len(vocab_tokens)))]
+                for _ in range(k))
+            samples.append((a, b, rn, serialize_np_array(pos), labs))
+        else:
+            samples.append((a, b, rn))
+    return samples
+
+
+@pytest.mark.parametrize("static", (False, True))
+def test_collate_matches_row_loop_reference(pipeline, static):
+    from lddl_tpu.loader.bert import BertCollate
+    tok = pipeline["tokenizer"]
+    samples = _synthetic_samples(tok, 37, static)
+    collate = BertCollate(tok, fixed_seq_length=48)
+    g = lrng.sample_rng(3, 0xC011, 0, 0, 0)
+    batch = collate(samples, g=None if static else g)
+    (ids, tt, am, stm, labels) = _reference_collate(tok, samples, 48)
+    np.testing.assert_array_equal(batch["token_type_ids"], tt)
+    np.testing.assert_array_equal(batch["attention_mask"], am)
+    np.testing.assert_array_equal(
+        batch["next_sentence_labels"],
+        np.asarray([int(s[2]) for s in samples], dtype=np.int32))
+    if static:
+        np.testing.assert_array_equal(batch["input_ids"], ids)
+        np.testing.assert_array_equal(batch["labels"], labels)
+    else:
+        # Same RNG stream + identical pre-mask encode => identical draws.
+        g2 = lrng.sample_rng(3, 0xC011, 0, 0, 0)
+        ref_ids, ref_labels = collate._mask_tokens(ids, stm, g2)
+        np.testing.assert_array_equal(batch["input_ids"], ref_ids)
+        np.testing.assert_array_equal(batch["labels"], ref_labels)
+
+
+def test_collate_throughput_floor(pipeline):
+    """Perf regression guard on the vectorized collate: pre-vectorization it
+    ran ~50k samples/s on this corpus shape; the scatter-based encode does
+    >100k. A 10x margin below keeps the test robust on slow CI."""
+    import time
+    from lddl_tpu.loader.bert import BertCollate
+    tok = pipeline["tokenizer"]
+    samples = _synthetic_samples(tok, 64, False)
+    collate = BertCollate(tok, fixed_seq_length=64)
+    g = lrng.sample_rng(3, 0xC011, 0, 0, 0)
+    collate(samples, g=g)  # warm
+    t0 = time.perf_counter()
+    iters = 30
+    for _ in range(iters):
+        collate(samples, g=g)
+    rate = 64 * iters / (time.perf_counter() - t0)
+    assert rate > 10_000, "collate regressed to {:.0f} samples/s".format(rate)
