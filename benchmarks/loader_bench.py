@@ -68,20 +68,23 @@ _PAD_RE = re.compile(r"padded-zero ratio: ([\d.]+)")
 _STEP_RE = re.compile(r"train step: ([\d.]+) ms avg")
 
 
-def _run_mock_train(path, vocab, extra):
+def _run_mock_train(path, vocab, extra, batch_size):
     cmd = [sys.executable, os.path.join(ROOT, "benchmarks", "mock_train.py"),
            "--path", path, "--vocab-file", vocab, "--epochs", "2",
-           "--log-freq", "1000000"] + extra
+           "--batch-size", str(batch_size), "--log-freq", "1000000"] + extra
     proc = subprocess.run(cmd, capture_output=True, text=True, cwd=ROOT)
     if proc.returncode != 0:
         raise RuntimeError("mock_train failed ({}):\n{}".format(
             proc.returncode, proc.stderr[-4000:]))
     out = proc.stdout
     m = _THROUGHPUT_RE.search(out)
+    ms = _SUSTAINED_RE.search(out)
+    if m is None or ms is None:
+        raise RuntimeError(
+            "mock_train output missing summary lines:\n" + out[-4000:])
     result = {"samples_per_s": float(m.group(1)),
               "ms_per_batch": float(m.group(2)),
-              "sustained_samples_per_s": float(
-                  _SUSTAINED_RE.search(out).group(1))}
+              "sustained_samples_per_s": float(ms.group(1))}
     m = _PAD_RE.search(out)
     if m:
         result["pad_ratio"] = float(m.group(1))
@@ -94,6 +97,7 @@ def _run_mock_train(path, vocab, extra):
 def main():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--mb", type=float, default=8.0)
+    p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--out", default=os.path.join(ROOT, "LOADER_BENCH.json"))
     p.add_argument("--with-model", action="store_true",
                    help="also measure with a jitted tiny-BERT train step")
@@ -119,13 +123,14 @@ def main():
                  "--fixed-seq-lengths", "32", "64", "96", "128"])
         results = {}
         for name, (path, extra) in configs.items():
-            results[name] = _run_mock_train(path, vocab, extra)
+            results[name] = _run_mock_train(path, vocab, extra,
+                                            args.batch_size)
             print(name, results[name], flush=True)
             payload = {
                 "unit": "samples/s (loader-only wall clock incl. decode, "
                         "shuffle buffer, collate, dynamic masking)",
                 "corpus_mb": args.mb,
-                "batch_size": 64,
+                "batch_size": args.batch_size,
                 "cpu_count": os.cpu_count(),
                 "configs": results,
             }

@@ -2,7 +2,9 @@ from .masking import (
     plan_num_to_predict,
     mask_batch_numpy,
     mask_batch_jax,
+    mask_whole_word_batch_numpy,
     make_jax_masker,
+    make_jax_whole_word_masker,
 )
 from .packing import pad_to_bucket, round_up
 
@@ -10,7 +12,9 @@ __all__ = [
     "plan_num_to_predict",
     "mask_batch_numpy",
     "mask_batch_jax",
+    "mask_whole_word_batch_numpy",
     "make_jax_masker",
+    "make_jax_whole_word_masker",
     "pad_to_bucket",
     "round_up",
 ]

@@ -57,6 +57,153 @@ def mask_batch_numpy(ids, candidate, num_to_predict, g, mask_id, vocab_size,
     return out, selected
 
 
+def _word_groups(ids, candidate, is_subword):
+    """Group candidate columns into whole words: a candidate column joins
+    the previous column's group when its token is a subword continuation
+    and the previous column is also a candidate. Returns (start, gid):
+    start[r,c] marks group heads; gid[r,c] numbers groups left-to-right
+    (meaningful only where candidate)."""
+    cont = np.zeros_like(candidate)
+    cont[:, 1:] = (candidate[:, 1:] & candidate[:, :-1]
+                   & is_subword[ids[:, 1:]])
+    start = candidate & ~cont
+    gid = np.cumsum(start, axis=1) - 1
+    return start, gid
+
+
+def mask_whole_word_batch_numpy(ids, candidate, num_to_predict, g, mask_id,
+                                vocab_size, is_subword, random_token_low=0):
+    """Vectorized whole-word masking: subword continuations group with
+    their word start and groups are selected atomically — a group is taken,
+    in random score order, iff it fits the remaining per-row budget
+    (Google-BERT wwm semantics: oversized groups are skipped, not split).
+
+    Frozen draw contract (identical to mask_batch_numpy's shapes, so the
+    stream is engine-checkable): scores [N,L], action [N,L], random ids
+    [N,L] — selection order is the stable ascending argsort of each
+    group's head-column score.
+    """
+    n, width = ids.shape
+    scores = g.random(ids.shape)
+    start, gid = _word_groups(ids, candidate, is_subword)
+    ngroups = start.sum(axis=1)
+    max_groups = max(int(ngroups.max()) if n else 0, 1)
+
+    sizes = np.zeros((n, max_groups), dtype=np.int64)
+    cand_r, cand_c = np.nonzero(candidate)
+    np.add.at(sizes, (cand_r, gid[cand_r, cand_c]), 1)
+    gscores = np.full((n, max_groups), np.inf)
+    head_r, head_c = np.nonzero(start)
+    gscores[head_r, gid[head_r, head_c]] = scores[head_r, head_c]
+
+    order = np.argsort(gscores, axis=1, kind="stable")
+    size_sorted = np.take_along_axis(sizes, order, axis=1)
+    valid_rank = np.arange(max_groups)[None, :] < ngroups[:, None]
+    taken = np.zeros(n, dtype=np.int64)
+    budget = np.asarray(num_to_predict, dtype=np.int64)
+    accept = np.zeros((n, max_groups), dtype=bool)
+    rows = np.arange(n)
+    # Greedy scan, vectorized over rows, sequential only over score rank.
+    for k in range(max_groups):
+        sz = size_sorted[:, k]
+        ok = valid_rank[:, k] & (taken < budget) & (taken + sz <= budget)
+        taken = np.where(ok, taken + sz, taken)
+        accept[rows[ok], order[ok, k]] = True
+
+    selected = np.zeros_like(candidate)
+    selected[cand_r, cand_c] = accept[cand_r, gid[cand_r, cand_c]]
+
+    action = g.random(ids.shape)
+    random_ids = g.integers(random_token_low, vocab_size, ids.shape,
+                            dtype=np.int64).astype(np.int32)
+    out = np.where(selected & (action < 0.8), mask_id, ids)
+    out = np.where(selected & (action >= 0.8) & (action < 0.9), random_ids,
+                   out)
+    return out, selected
+
+
+def _mask_whole_word_jax_impl(ids, candidate, num_to_predict, key,
+                              is_subword, mask_id, vocab_size,
+                              random_token_low):
+    import jax
+    import jax.numpy as jnp
+
+    n, width = ids.shape
+    k_sel, k_act, k_rand = jax.random.split(key, 3)
+    scores = jax.random.uniform(k_sel, ids.shape)
+
+    cont = jnp.zeros_like(candidate)
+    cont = cont.at[:, 1:].set(candidate[:, 1:] & candidate[:, :-1]
+                              & is_subword[ids[:, 1:]])
+    start = candidate & ~cont
+    gid = jnp.cumsum(start, axis=1) - 1
+    ngroups = start.sum(axis=1)
+
+    rows = jnp.arange(n)
+    # Per-(row, gid) aggregates via segment ids r*width + gid; gid < width
+    # always, so segments never collide across rows.
+    seg = (rows[:, None] * width + jnp.clip(gid, 0)).reshape(-1)
+    sizes = jax.ops.segment_sum(candidate.reshape(-1).astype(jnp.int32), seg,
+                                num_segments=n * width).reshape(n, width)
+    gscores = jnp.full((n, width), jnp.inf).at[
+        rows[:, None], jnp.clip(gid, 0)].min(
+            jnp.where(start, scores, jnp.inf))
+
+    order = jnp.argsort(gscores, axis=1)
+    size_sorted = jnp.take_along_axis(sizes, order, axis=1)
+    valid_rank = jnp.arange(width)[None, :] < ngroups[:, None]
+    budget = num_to_predict.astype(jnp.int32)
+
+    def step(carry, k):
+        taken, accept = carry
+        sz = size_sorted[:, k].astype(jnp.int32)
+        ok = valid_rank[:, k] & (taken < budget) & (taken + sz <= budget)
+        taken = jnp.where(ok, taken + sz, taken)
+        accept = accept.at[rows, order[:, k]].set(
+            accept[rows, order[:, k]] | ok)
+        return (taken, accept), None
+
+    (_, accept), _ = jax.lax.scan(
+        step, (jnp.zeros(n, jnp.int32), jnp.zeros((n, width), bool)),
+        jnp.arange(width))
+    selected = candidate & accept[rows[:, None], jnp.clip(gid, 0)]
+
+    action = jax.random.uniform(k_act, ids.shape)
+    random_ids = jax.random.randint(k_rand, ids.shape, random_token_low,
+                                    vocab_size, dtype=jnp.int32)
+    out = jnp.where(selected & (action < 0.8), mask_id, ids)
+    out = jnp.where(selected & (action >= 0.8) & (action < 0.9), random_ids,
+                    out)
+    return out, selected
+
+
+def make_jax_whole_word_masker(mask_id, vocab_size, is_subword,
+                               random_token_low=0):
+    """jit'd whole-word masking kernel (same call shape as
+    make_jax_masker's runner)."""
+    import jax
+    import jax.numpy as jnp
+    import functools
+
+    impl = functools.partial(
+        _mask_whole_word_jax_impl,
+        mask_id=mask_id,
+        vocab_size=vocab_size,
+        random_token_low=random_token_low,
+    )
+    jitted = jax.jit(impl)
+    is_subword = jnp.asarray(is_subword)
+
+    def run(ids, candidate, num_to_predict, seed):
+        key = jax.random.key(np.uint32(seed))
+        out, selected = jitted(ids, candidate,
+                               np.asarray(num_to_predict, np.int32), key,
+                               is_subword)
+        return np.asarray(out), np.asarray(selected)
+
+    return run
+
+
 def _mask_batch_jax_impl(ids, candidate, num_to_predict, key, mask_id,
                          vocab_size, random_token_low):
     import jax

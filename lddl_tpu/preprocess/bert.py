@@ -28,7 +28,13 @@ import dataclasses
 
 import numpy as np
 
-from ..ops.masking import mask_batch_numpy, make_jax_masker, plan_num_to_predict
+from ..ops.masking import (
+    make_jax_masker,
+    make_jax_whole_word_masker,
+    mask_batch_numpy,
+    mask_whole_word_batch_numpy,
+    plan_num_to_predict,
+)
 from ..utils.fs import serialize_np_array
 from ..utils import rng as lrng
 from .sentences import split_sentences
@@ -471,52 +477,61 @@ def apply_static_masking(batch, config, tok_info, seed, scope):
     num_to_predict = plan_num_to_predict(seq_lens, config.masked_lm_ratio,
                                          config.max_predictions_per_seq)
 
-    if config.whole_word_masking:
-        masked, selected = _mask_whole_word(ids, candidate, num_to_predict,
-                                            tok_info,
-                                            lrng.sample_rng(seed, *scope))
-    elif config.engine == "jax":
-        masker = _get_jax_masker(tok_info)
-        # jit keys compilations on the full shape and every bucket has a
-        # different row count, so run in fixed-size row chunks: all full
-        # chunks share ONE compiled shape per width bucket; only the last
-        # partial chunk pads up to a power of two (floor 64). Compilation
-        # count stays O(log chunk) per width, padding waste stays small.
-        n = ids.shape[0]
-        chunk = 2048
-        # Fold the scope into a 32-bit seed for jax.random; vary per chunk
-        # so chunking does not correlate the streams.
-        import hashlib
-
-        def _seed_of(ci):
-            h = hashlib.blake2b(
-                ("{}:{}:{}".format(seed, scope, ci)).encode(),
-                digest_size=4).digest()
-            return int.from_bytes(h, "little")
-
-        masked_parts, selected_parts = [], []
-        for ci, start in enumerate(range(0, n, chunk)):
-            ids_c = ids[start:start + chunk]
-            cand_c = candidate[start:start + chunk]
-            num_c = num_to_predict[start:start + chunk]
-            nc = ids_c.shape[0]
-            n_pad = min(chunk, 1 << max(6, (nc - 1).bit_length()))
-            if n_pad > nc:
-                ids_c = np.pad(ids_c, ((0, n_pad - nc), (0, 0)))
-                cand_c = np.pad(cand_c, ((0, n_pad - nc), (0, 0)))
-                num_c = np.pad(num_c, (0, n_pad - nc))
-            m_c, s_c = masker(ids_c, cand_c, num_c, _seed_of(ci))
-            masked_parts.append(np.asarray(m_c[:nc]))
-            selected_parts.append(np.asarray(s_c[:nc]))
-        masked = np.concatenate(masked_parts) if masked_parts else ids
-        selected = (np.concatenate(selected_parts)
-                    if selected_parts else np.zeros_like(candidate))
+    if config.engine == "jax":
+        masker = (_get_jax_wwm_masker(tok_info) if config.whole_word_masking
+                  else _get_jax_masker(tok_info))
+        masked, selected = _run_jax_chunked(masker, ids, candidate,
+                                            num_to_predict, seed, scope)
+    elif config.whole_word_masking:
+        masked, selected = mask_whole_word_batch_numpy(
+            ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),
+            tok_info.mask_id, tok_info.vocab_size, tok_info.is_subword)
     else:
         masked, selected = mask_batch_numpy(
             ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),
             tok_info.mask_id, tok_info.vocab_size)
 
     return masked, selected, ids, a_lens, seq_lens
+
+
+def _run_jax_chunked(masker, ids, candidate, num_to_predict, seed, scope):
+    """Run a jit'd masker in fixed-size row chunks.
+
+    jit keys compilations on the full shape and every bucket has a
+    different row count, so run in fixed-size row chunks: all full chunks
+    share ONE compiled shape per width bucket; only the last partial chunk
+    pads up to a power of two (floor 64). Compilation count stays O(log
+    chunk) per width, padding waste stays small."""
+    n = ids.shape[0]
+    chunk = 2048
+    # Fold the scope into a 32-bit seed for jax.random; vary per chunk
+    # so chunking does not correlate the streams.
+    import hashlib
+
+    def _seed_of(ci):
+        h = hashlib.blake2b(
+            ("{}:{}:{}".format(seed, scope, ci)).encode(),
+            digest_size=4).digest()
+        return int.from_bytes(h, "little")
+
+    masked_parts, selected_parts = [], []
+    for ci, start in enumerate(range(0, n, chunk)):
+        ids_c = ids[start:start + chunk]
+        cand_c = candidate[start:start + chunk]
+        num_c = num_to_predict[start:start + chunk]
+        nc = ids_c.shape[0]
+        n_pad = min(chunk, 1 << max(6, (nc - 1).bit_length()))
+        if n_pad > nc:
+            ids_c = np.pad(ids_c, ((0, n_pad - nc), (0, 0)))
+            cand_c = np.pad(cand_c, ((0, n_pad - nc), (0, 0)))
+            num_c = np.pad(num_c, (0, n_pad - nc))
+        m_c, s_c = masker(ids_c, cand_c, num_c, _seed_of(ci))
+        masked_parts.append(np.asarray(m_c[:nc]))
+        selected_parts.append(np.asarray(s_c[:nc]))
+    masked = np.concatenate(masked_parts) if masked_parts else ids
+    selected = (np.concatenate(selected_parts)
+                if selected_parts else np.zeros_like(candidate))
+    return masked, selected
 
 
 _JAX_MASKERS = {}
@@ -530,40 +545,18 @@ def _get_jax_masker(tok_info):
     return _JAX_MASKERS[key]
 
 
-def _mask_whole_word(ids, candidate, num_to_predict, tok_info, g):
-    """Whole-word masking: subword continuations group with their word
-    start; groups are selected atomically. Per-row loop (rarely used)."""
-    out = ids.copy()
-    selected = np.zeros_like(candidate)
-    is_sub = tok_info.is_subword
-    for r in range(ids.shape[0]):
-        cols = np.nonzero(candidate[r])[0]
-        groups = []
-        for c in cols:
-            if groups and is_sub[ids[r, c]] and groups[-1][-1] == c - 1:
-                groups[-1].append(c)
-            else:
-                groups.append([c])
-        # Stable argsort of raw uniforms (not Generator.permutation) keeps
-        # the stream numpy-version-stable, matching utils.rng.shuffle.
-        order = np.argsort(g.random(len(groups)), kind="stable")
-        budget = int(num_to_predict[r])
-        taken = 0
-        for gi in order:
-            group = groups[gi]
-            if taken >= budget:
-                break
-            if taken + len(group) > budget:
-                continue
-            for c in group:
-                r_act = g.random()
-                if r_act < 0.8:
-                    out[r, c] = tok_info.mask_id
-                elif r_act < 0.9:
-                    out[r, c] = int(g.integers(0, tok_info.vocab_size))
-                selected[r, c] = True
-                taken += 1
-    return out, selected
+_JAX_WWM_MASKERS = {}
+
+
+def _get_jax_wwm_masker(tok_info):
+    # is_subword must be part of the key: two vocabs of the same size and
+    # mask_id can group words differently.
+    key = (tok_info.mask_id, tok_info.vocab_size,
+           hash(tok_info.is_subword.tobytes()))
+    if key not in _JAX_WWM_MASKERS:
+        _JAX_WWM_MASKERS[key] = make_jax_whole_word_masker(
+            tok_info.mask_id, tok_info.vocab_size, tok_info.is_subword)
+    return _JAX_WWM_MASKERS[key]
 
 
 def materialize_rows(batch, config, tok_info, seed, scope):
@@ -653,15 +646,12 @@ def create_masked_lm_predictions(tokens, vocab_words, g, masked_lm_ratio,
     num = plan_num_to_predict([len(tokens)], masked_lm_ratio,
                               max_predictions_per_seq)
     if whole_word_masking:
-        class _Shim:
-            pass
-        shim = _Shim()
-        shim.mask_id = mask_reserved
-        shim.vocab_size = len(vocab_words)
-        shim.is_subword = np.array(
+        is_subword = np.array(
             [t.startswith("##") for t in vocab_words]
             + [False] * len(extra), dtype=bool)
-        masked, selected = _mask_whole_word(ids, candidate, num, shim, g)
+        masked, selected = mask_whole_word_batch_numpy(
+            ids, candidate, num, g, mask_reserved, len(vocab_words),
+            is_subword)
     else:
         masked, selected = mask_batch_numpy(ids, candidate, num, g,
                                             mask_reserved, len(vocab_words))

@@ -110,3 +110,138 @@ def test_engine_parity_e2e(tmp_path, tiny_corpus):
     # Both engines actually masked.
     assert any(r["masked_lm_labels"] for r in npy)
     assert any(r["masked_lm_labels"] for r in jx)
+
+
+def _wwm_row_oracle(ids, candidate, num_to_predict, g, mask_id, vocab,
+                    is_subword):
+    """Per-row whole-word masking consuming the SAME frozen draw contract
+    as mask_whole_word_batch_numpy (scores/action/random_ids matrices), so
+    parity is bit-exact."""
+    n, L = ids.shape
+    scores = g.random(ids.shape)
+    action = g.random(ids.shape)
+    random_ids = g.integers(0, vocab, ids.shape,
+                            dtype=np.int64).astype(np.int32)
+    out = ids.copy()
+    selected = np.zeros_like(candidate)
+    for r in range(n):
+        cols = np.nonzero(candidate[r])[0]
+        groups = []
+        for c in cols:
+            if groups and is_subword[ids[r, c]] and groups[-1][-1] == c - 1:
+                groups[-1].append(int(c))
+            else:
+                groups.append([int(c)])
+        gscores = [scores[r, grp[0]] for grp in groups]
+        order = np.argsort(gscores, kind="stable")
+        budget = int(num_to_predict[r])
+        taken = 0
+        for gi in order:
+            grp = groups[gi]
+            if taken >= budget:
+                break
+            if taken + len(grp) > budget:
+                continue
+            for c in grp:
+                if action[r, c] < 0.8:
+                    out[r, c] = mask_id
+                elif action[r, c] < 0.9:
+                    out[r, c] = random_ids[r, c]
+                selected[r, c] = True
+                taken += 1
+    return out, selected
+
+
+def _wwm_setup(n=128, L=96, vocab=1000, seed=3, sub_frac=0.3):
+    g = np.random.default_rng(seed)
+    ids, candidate, lens = _setup(n=n, L=L, vocab=vocab, seed=seed)
+    # Mark a fraction of the vocab as subword continuations so real
+    # multi-token groups form.
+    is_subword = g.random(vocab) < sub_frac
+    is_subword[:10] = False  # specials never continue a word
+    return ids, candidate, lens, is_subword
+
+
+def test_mask_whole_word_batch_matches_row_oracle():
+    from lddl_tpu.ops import mask_whole_word_batch_numpy
+    ids, candidate, lens, is_subword = _wwm_setup(n=256)
+    num = plan_num_to_predict(lens, 0.15, 20)
+    masked, selected = mask_whole_word_batch_numpy(
+        ids, candidate, num, lrng.sample_rng(5, 1), 3, 1000, is_subword)
+    ref_masked, ref_selected = _wwm_row_oracle(
+        ids, candidate, num, lrng.sample_rng(5, 1), 3, 1000, is_subword)
+    np.testing.assert_array_equal(selected, ref_selected)
+    np.testing.assert_array_equal(masked, ref_masked)
+
+
+def _check_wwm_invariants(ids, candidate, is_subword, selected, num):
+    # Budget respected.
+    assert (selected.sum(axis=1) <= num).all()
+    # Whole words selected atomically: selection state constant per group.
+    for r in range(ids.shape[0]):
+        cols = np.nonzero(candidate[r])[0]
+        prev = None
+        for c in cols:
+            if prev is not None and prev == c - 1 and is_subword[ids[r, c]]:
+                assert selected[r, c] == selected[r, c - 1]
+            prev = c
+    # Only candidates selected.
+    assert not (selected & ~candidate).any()
+
+
+def test_mask_whole_word_batch_invariants():
+    from lddl_tpu.ops import mask_whole_word_batch_numpy
+    ids, candidate, lens, is_subword = _wwm_setup(n=128)
+    num = plan_num_to_predict(lens, 0.15, 20)
+    masked, selected = mask_whole_word_batch_numpy(
+        ids, candidate, num, lrng.sample_rng(5, 2), 3, 1000, is_subword)
+    _check_wwm_invariants(ids, candidate, is_subword, selected, num)
+    assert selected.sum() > 0
+    # Unselected positions unchanged.
+    assert (masked[~selected] == ids[~selected]).all()
+
+
+def test_mask_whole_word_jax():
+    from lddl_tpu.ops import make_jax_whole_word_masker
+    ids, candidate, lens, is_subword = _wwm_setup(n=64, L=64)
+    num = plan_num_to_predict(lens, 0.15, 20)
+    masker = make_jax_whole_word_masker(3, 1000, is_subword)
+    masked, selected = masker(ids, candidate, num, seed=11)
+    _check_wwm_invariants(ids, candidate, is_subword, selected, num)
+    assert selected.sum() > 0
+    assert (masked[~selected] == ids[~selected]).all()
+    masked2, _ = masker(ids, candidate, num, seed=11)
+    np.testing.assert_array_equal(masked, masked2)
+    masked3, _ = masker(ids, candidate, num, seed=12)
+    assert not np.array_equal(masked, masked3)
+
+
+def test_wwm_e2e_both_engines(tmp_path, tiny_corpus):
+    """whole_word_masking runs through both engines end-to-end with
+    identical pair structure."""
+    from lddl_tpu.preprocess import (BertPretrainConfig, build_wordpiece_vocab,
+                                     get_tokenizer, run_bert_preprocess)
+    from lddl_tpu.utils.fs import get_all_parquets_under
+    import pyarrow.parquet as pq
+
+    vocab = build_wordpiece_vocab(
+        ["alpha beta gamma delta epsilon zeta eta theta iota kappa"] * 3,
+        str(tmp_path / "v.txt"), vocab_size=60)  # small -> real subwords
+    tok = get_tokenizer(vocab_file=vocab)
+    outs = {}
+    for engine in ("numpy", "jax"):
+        out = str(tmp_path / engine)
+        run_bert_preprocess(
+            {"w": tiny_corpus}, out, tok,
+            config=BertPretrainConfig(max_seq_length=64, duplicate_factor=1,
+                                      masking=True, engine=engine,
+                                      whole_word_masking=True),
+            num_blocks=2, sample_ratio=1.0, seed=0, bin_size=16)
+        outs[engine] = [r for p in get_all_parquets_under(out)
+                        for r in pq.read_table(p).to_pylist()]
+    npy, jx = outs["numpy"], outs["jax"]
+    assert len(npy) == len(jx) > 0
+    key = lambda r: (r["num_tokens"], r["is_random_next"])
+    assert sorted(map(key, npy)) == sorted(map(key, jx))
+    assert any(r["masked_lm_labels"] for r in npy)
+    assert any(r["masked_lm_labels"] for r in jx)
