@@ -2,7 +2,7 @@ from .datasets import ShuffleBuffer, ParquetDataset
 from .dataloader import DataLoader, Binned
 from .bert import get_bert_pretrain_data_loader, BertPretrainBinned
 from .bart import get_bart_pretrain_data_loader, BartCollate
-from .sharding import process_dp_info, to_device_batch
+from .sharding import dp_info_of_process, process_dp_info, to_device_batch
 
 __all__ = [
     "ShuffleBuffer",
@@ -13,6 +13,7 @@ __all__ = [
     "get_bart_pretrain_data_loader",
     "BartCollate",
     "BertPretrainBinned",
+    "dp_info_of_process",
     "process_dp_info",
     "to_device_batch",
 ]

@@ -19,34 +19,39 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import mesh_data_axes
+from ..parallel.mesh import DATA_AXES, mesh_data_axes
 
 
-def _batch_block_of_device(mesh, coords, data_axes):
+def _batch_block_of_device(device_shape, axis_names, coords, data_axes):
     """Index of the batch block a device at ``coords`` consumes, i.e. its
     position along the flattened data axes."""
     block = 0
     for axis in data_axes:
-        axis_idx = mesh.axis_names.index(axis)
-        block = block * mesh.devices.shape[axis_idx] + coords[axis_idx]
+        axis_idx = axis_names.index(axis)
+        block = block * device_shape[axis_idx] + coords[axis_idx]
     return block
 
 
-def process_dp_info(mesh):
-    """(dp_rank, num_dp_groups) of the calling process for ``mesh``.
+def dp_info_of_process(device_array, axis_names, process_index):
+    """Core grouping rule of ``process_dp_info`` over a plain ndarray of
+    device-like objects (anything with a ``process_index`` attribute) —
+    callable with synthetic devices to validate a mesh layout without a
+    real multi-process runtime.
 
     Grouping rule: two processes belong to the same data-parallel group iff
-    their addressable mesh devices cover exactly the same set of batch
-    blocks. Groups are ordered by their smallest block so dp_rank is stable
-    and identical on every process.
+    their devices cover exactly the same set of batch blocks. Groups are
+    ordered by their smallest block so dp_rank is stable and identical on
+    every process.
     """
-    data_axes = mesh_data_axes(mesh)
+    axis_names = tuple(axis_names)
+    data_axes = tuple(a for a in axis_names if a in DATA_AXES)
     if not data_axes:
         return 0, 1
     blocks_by_process = {}
-    for coords in np.ndindex(*mesh.devices.shape):
-        device = mesh.devices[coords]
-        block = _batch_block_of_device(mesh, coords, data_axes)
+    for coords in np.ndindex(*device_array.shape):
+        device = device_array[coords]
+        block = _batch_block_of_device(device_array.shape, axis_names,
+                                       coords, data_axes)
         blocks_by_process.setdefault(device.process_index, set()).add(block)
 
     groups = {}
@@ -62,12 +67,21 @@ def process_dp_info(mesh):
                 "groups; choose a mesh whose data axes align with hosts")
         seen |= blocks
 
-    this_process = jax.process_index()
     for dp_rank, blocks in enumerate(ordered):
-        if this_process in groups[blocks]:
+        if process_index in groups[blocks]:
             return dp_rank, len(ordered)
     raise RuntimeError(
-        "process {} owns no devices in the mesh".format(this_process))
+        "process {} owns no devices in the mesh".format(process_index))
+
+
+def process_dp_info(mesh):
+    """(dp_rank, num_dp_groups) of the calling process for ``mesh``.
+
+    See ``dp_info_of_process`` for the grouping rule; this binds it to the
+    real mesh and ``jax.process_index()``.
+    """
+    return dp_info_of_process(mesh.devices, mesh.axis_names,
+                              jax.process_index())
 
 
 def batch_sharding(mesh, rank=2):

@@ -552,7 +552,7 @@ def _get_jax_wwm_masker(tok_info):
     # is_subword must be part of the key: two vocabs of the same size and
     # mask_id can group words differently.
     key = (tok_info.mask_id, tok_info.vocab_size,
-           hash(tok_info.is_subword.tobytes()))
+           tok_info.is_subword.tobytes())
     if key not in _JAX_WWM_MASKERS:
         _JAX_WWM_MASKERS[key] = make_jax_whole_word_masker(
             tok_info.mask_id, tok_info.vocab_size, tok_info.is_subword)

@@ -6,6 +6,7 @@ import pytest
 
 from lddl_tpu.loader import (
     ShuffleBuffer,
+    dp_info_of_process,
     get_bert_pretrain_data_loader,
     process_dp_info,
     to_device_batch,
@@ -357,3 +358,80 @@ def test_collate_throughput_floor(pipeline):
         collate(samples, g=g)
     rate = 64 * iters / (time.perf_counter() - t0)
     assert rate > 10_000, "collate regressed to {:.0f} samples/s".format(rate)
+
+
+class _FakeDevice:
+    """Synthetic device carrying only process_index, for layout tests."""
+
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+    def __repr__(self):
+        return "dev(p{})".format(self.process_index)
+
+
+def _device_grid(proc_of_coords, shape):
+    arr = np.empty(shape, dtype=object)
+    for coords in np.ndindex(*shape):
+        arr[coords] = _FakeDevice(proc_of_coords(coords))
+    return arr
+
+
+def test_dp_info_dp_across_hosts():
+    """(dp=4, tp=2), each host owns one full dp slice (its tp pair):
+    4 groups, dp_rank == host index."""
+    devices = _device_grid(lambda c: c[0], (4, 2))
+    for p in range(4):
+        assert dp_info_of_process(devices, ("dp", "tp"), p) == (p, 4)
+
+
+def test_dp_info_tp_across_hosts():
+    """(dp=2, tp=4), tp split across two hosts per dp block: TP peers on
+    different hosts share a dp_rank."""
+    devices = _device_grid(lambda c: c[0] * 2 + c[1] // 2, (2, 4))
+    assert dp_info_of_process(devices, ("dp", "tp"), 0) == (0, 2)
+    assert dp_info_of_process(devices, ("dp", "tp"), 1) == (0, 2)
+    assert dp_info_of_process(devices, ("dp", "tp"), 2) == (1, 2)
+    assert dp_info_of_process(devices, ("dp", "tp"), 3) == (1, 2)
+
+
+def test_dp_info_combined_data_axes():
+    """(dp=2, fsdp=2, tp=2): batch blocks flatten over BOTH data axes; one
+    host per (dp, fsdp) coordinate gives 4 groups ordered by block."""
+    devices = _device_grid(lambda c: c[0] * 2 + c[1], (2, 2, 2))
+    for p in range(4):
+        assert dp_info_of_process(devices, ("dp", "fsdp", "tp"),
+                                  p) == (p, 4)
+
+
+def test_dp_info_host_spans_blocks():
+    """A host owning several whole dp blocks is one group; peers must
+    cover the same set."""
+    # (dp=4, tp=2): host 0 owns dp blocks {0,1}, host 1 owns {2,3}.
+    devices = _device_grid(lambda c: c[0] // 2, (4, 2))
+    assert dp_info_of_process(devices, ("dp", "tp"), 0) == (0, 2)
+    assert dp_info_of_process(devices, ("dp", "tp"), 1) == (1, 2)
+
+
+def test_dp_info_invalid_overlapping_layout():
+    """A host straddling dp blocks that another host covers only partially
+    is rejected."""
+    # (dp=2, tp=2): p0 owns (0,t0),(1,t0); p1 owns (0,t1); p2 owns (1,t1).
+    def proc(c):
+        if c[1] == 0:
+            return 0
+        return 1 + c[0]
+    devices = _device_grid(proc, (2, 2))
+    with pytest.raises(ValueError, match="multiple process groups"):
+        dp_info_of_process(devices, ("dp", "tp"), 0)
+
+
+def test_dp_info_no_data_axes():
+    devices = _device_grid(lambda c: c[0], (4,))
+    assert dp_info_of_process(devices, ("tp",), 2) == (0, 1)
+
+
+def test_dp_info_unknown_process_raises():
+    devices = _device_grid(lambda c: 0, (2, 2))
+    with pytest.raises(RuntimeError, match="owns no devices"):
+        dp_info_of_process(devices, ("dp", "tp"), 7)
