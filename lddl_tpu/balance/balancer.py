@@ -20,9 +20,14 @@ sum-allreduce (census) plus one barrier per iteration; rows never ride the
 network directly.
 
 Differences from the reference (improvements, not drift):
-- Transfers move ``min(surplus, deficit)`` against exact per-shard targets
-  instead of halving pair differences, so convergence takes O(1) iterations
-  for typical skew rather than O(log skew).
+- Transfers move exact ``min(surplus, deficit)`` amounts in one two-pointer
+  sweep instead of halving pair differences: convergence is a single
+  iteration for ANY skew (the reference's scheme is O(log skew) iterations,
+  each a barrier + re-read). All transfers out of one source shard are
+  grouped into a single load (``transfer_to_many``), so a giant input
+  feeding many shards is read once, not once per destination — the
+  ``stats`` dict quantifies this (rows_read <= total rows, property-tested
+  in tests/test_balance.py).
 - Empty-input edge cases raise clean errors instead of asserting deep in
   pyarrow.
 """
@@ -44,52 +49,70 @@ from ..utils.types import File
 
 
 class _Shard:
-    """One output shard: the input Files still feeding it plus an output
-    file accumulating rows it has taken custody of. All ranks track the
-    same metadata; only transfer owners move actual rows."""
+    """One output shard: the input Files still feeding it plus *part files*
+    holding rows it has taken custody of. All ranks track the same
+    metadata; only transfer owners move actual rows.
 
-    def __init__(self, idx, input_files, out_dir, postfix=""):
+    Custody is write-once part files (``<out>.partK``, K a metadata-
+    replicated sequence number): every store persists a FRESH file, never a
+    read-modify-write — so two transfers owned by different ranks can land
+    rows on the same destination within one barrier window without racing,
+    and appending never re-reads accumulated rows. ``flush`` merges the
+    remaining inputs + parts into the final shard file after the
+    convergence barrier.
+
+    ``stats`` (optional dict) accumulates the I/O the plan implies, in
+    rows, identically on every rank (the plan is SPMD-replicated):
+    ``rows_read`` counts source-file reads, ``rows_reread`` part-file
+    drain re-reads, ``rows_written`` rows persisted (leftovers, landed
+    transfers, and the final merge). A minimal pass costs total_rows read
+    + total_rows written; everything above that is the balancing overhead
+    being quantified."""
+
+    def __init__(self, idx, input_files, out_dir, postfix="", stats=None):
         self.idx = idx
         self.input_files = list(input_files)
         self.out_path = os.path.join(
             out_dir, "shard-{}.parquet{}".format(idx, postfix))
-        self.output_file = None  # File once any rows land in out_path
+        self.output_parts = []  # custody Files, deterministic paths
+        self._part_seq = 0
+        self.stats = stats
+
+    def _count(self, key, n):
+        if self.stats is not None:
+            self.stats[key] = self.stats.get(key, 0) + int(n)
 
     @property
     def num_samples(self):
-        n = sum(f.num_samples for f in self.input_files)
-        if self.output_file is not None:
-            n += self.output_file.num_samples
-        return n
+        return (sum(f.num_samples for f in self.input_files)
+                + sum(f.num_samples for f in self.output_parts))
 
     def _store(self, num_samples, table=None):
-        """Append rows to the output file. ``table`` is given only on the
-        rank doing real I/O; all other ranks mirror the count."""
+        """Take custody of rows in a fresh part file. ``table`` is given
+        only on the rank doing real I/O; all other ranks mirror the
+        metadata (including the part sequence number)."""
+        assert num_samples > 0
+        path = "{}.part{}".format(self.out_path, self._part_seq)
+        self._part_seq += 1
+        self.output_parts.append(File(path, num_samples))
+        self._count("rows_written", num_samples)
         if table is not None:
             assert table.num_rows == num_samples
-        if self.output_file is None:
-            self.output_file = File(self.out_path, 0)
-        elif table is not None and self.output_file.num_samples > 0:
-            table = pa.concat_tables([pq.read_table(self.out_path), table])
-        self.output_file.num_samples += num_samples
-        if table is not None:
-            assert table.num_rows == self.output_file.num_samples
-            pq.write_table(table, self.out_path)
+            pq.write_table(table, path)
 
     def _load(self, num_samples, with_table):
-        """Remove rows, consuming input files from the end first, then the
-        output file. Leftovers of a partially-consumed file are re-stored
-        to the output file (persisted immediately when ``with_table``)."""
+        """Remove rows, consuming input files from the end first, then
+        custody parts. The leftover of a partially-consumed source becomes
+        a fresh part (persisted immediately when ``with_table``)."""
         assert num_samples <= self.num_samples
         tables = [] if with_table else None
         while num_samples > 0:
             from_output = not self.input_files
-            if from_output:
-                src = self.output_file
-                self.output_file = None
-            else:
-                src = self.input_files.pop()
+            src = (self.output_parts.pop() if from_output
+                   else self.input_files.pop())
             take = min(src.num_samples, num_samples)
+            self._count("rows_reread" if from_output else "rows_read",
+                        src.num_samples)
             src_table = None
             if with_table:
                 src_table = pq.read_table(src.path)
@@ -99,36 +122,49 @@ class _Shard:
                 self._store(
                     src.num_samples - take,
                     table=src_table.slice(take) if with_table else None)
-            elif from_output and with_table:
-                # Output file fully drained: delete it so stale rows cannot
-                # be rediscovered by directory globbing. (A later _store for
-                # this shard recreates the file fresh.)
+            if from_output and with_table:
+                # The popped part is dead (its leftover, if any, moved to a
+                # fresh part above): delete so stale rows cannot linger.
                 os.remove(src.path)
             num_samples -= take
         if with_table:
             return pa.concat_tables(tables)
         return None
 
-    def transfer_to(self, other, num_samples, i_am_owner):
-        other._store(num_samples,
-                     table=self._load(num_samples, with_table=i_am_owner))
+    def transfer_to_many(self, assignments, i_am_owner):
+        """Move rows to several shards with ONE load of this shard:
+        ``assignments`` is [(shard, num_samples), ...]. Grouping all
+        transfers out of a source avoids re-reading its leftover once per
+        destination (the dominant I/O cost when one giant file feeds many
+        shards)."""
+        total = sum(n for _, n in assignments)
+        table = self._load(total, with_table=i_am_owner)
+        offset = 0
+        for other, n in assignments:
+            other._store(n, table=table.slice(offset, n) if i_am_owner
+                         else None)
+            offset += n
 
     def flush(self, i_am_owner):
-        """Fold any remaining input files into the output shard file.
-
-        ``_load`` always pops whole input files (a partially-consumed file's
-        leftover moves to the output file immediately), so everything still
-        listed here is an intact original.
-        """
-        remaining = [f for f in self.input_files if f.num_samples > 0]
+        """Merge remaining input files + custody parts into the final
+        shard file. Must run after a barrier so every part written by any
+        owner is visible; every shard flushes exactly once."""
+        inputs = [f for f in self.input_files if f.num_samples > 0]
+        sources = inputs + self.output_parts
         self.input_files = []
-        if not remaining:
-            return
-        n = sum(f.num_samples for f in remaining)
-        table = None
+        parts, self.output_parts = self.output_parts, []
+        n = sum(f.num_samples for f in sources)
+        assert n > 0, "shard {} would be empty".format(self.idx)
+        self._count("rows_read", sum(f.num_samples for f in inputs))
+        self._count("rows_reread", sum(f.num_samples for f in parts))
+        self._count("rows_written", n)
         if i_am_owner:
-            table = pa.concat_tables([pq.read_table(f.path) for f in remaining])
-        self._store(n, table=table)
+            table = pa.concat_tables([pq.read_table(f.path) for f in sources])
+            assert table.num_rows == n
+            pq.write_table(table, self.out_path)
+            for f in parts:
+                os.remove(f.path)
+        self.final_file = File(self.out_path, n)
 
 
 def _census(file_paths, comm):
@@ -141,39 +177,51 @@ def _census(file_paths, comm):
     return [File(p, int(n)) for p, n in zip(file_paths, counts)]
 
 
-def _balance_one_set(file_paths, out_dir, num_shards, comm, postfix=""):
-    """Balance one (possibly per-bin) file set into num_shards outputs."""
-    files = _census(file_paths, comm)
-    total = sum(f.num_samples for f in files)
-    if total < num_shards:
-        raise ValueError(
-            "cannot balance {} samples into {} shards; every shard must "
-            "receive at least one sample".format(total, num_shards))
+def compute_targets(total, num_shards):
+    """Per-shard target counts: base everywhere, +1 on the first
+    ``total % num_shards`` shards."""
     base = total // num_shards
     num_plus_one = total - base * num_shards
-    targets = [base + (1 if i < num_plus_one else 0) for i in range(num_shards)]
+    return [base + (1 if i < num_plus_one else 0) for i in range(num_shards)]
 
-    shards = [
-        _Shard(i, files[i::num_shards], out_dir, postfix=postfix)
-        for i in range(num_shards)
-    ]
 
-    transfer_idx = 0
-    for _ in range(num_shards + 2):
+def _converge(shards, targets, comm):
+    """Drive shards to exact targets via owner-striped transfers.
+
+    One sweep suffices: surpluses and deficits sum to zero by construction,
+    and the two-pointer walk pairs them off exactly, grouping every
+    transfer out of one source shard into a single load. Deterministic SPMD
+    control flow; the iteration bound is a safety net, not an expectation.
+    Exposed separately so the plan can be property-tested metadata-only
+    (no rank ever owning a transfer)."""
+    group_idx = 0
+    iterations = 0
+    for _ in range(len(shards) + 2):
         large = [s for s in shards if s.num_samples > targets[s.idx]]
         small = [s for s in shards if s.num_samples < targets[s.idx]]
         if not large and not small:
             break
+        iterations += 1
         large.sort(key=lambda s: s.num_samples - targets[s.idx], reverse=True)
         small.sort(key=lambda s: targets[s.idx] - s.num_samples, reverse=True)
-        for ls, ss in zip(large, small):
-            n = min(ls.num_samples - targets[ls.idx],
-                    targets[ss.idx] - ss.num_samples)
-            if n <= 0:
-                continue
-            ls.transfer_to(
-                ss, n, i_am_owner=(transfer_idx % comm.world_size == comm.rank))
-            transfer_idx += 1
+        deficits = {s.idx: targets[s.idx] - s.num_samples for s in small}
+        si = 0
+        for ls in large:
+            surplus = ls.num_samples - targets[ls.idx]
+            assignments = []
+            while surplus > 0 and si < len(small):
+                ss = small[si]
+                n = min(surplus, deficits[ss.idx])
+                assignments.append((ss, n))
+                surplus -= n
+                deficits[ss.idx] -= n
+                if deficits[ss.idx] == 0:
+                    si += 1
+            if assignments:
+                ls.transfer_to_many(
+                    assignments,
+                    i_am_owner=(group_idx % comm.world_size == comm.rank))
+                group_idx += 1
         comm.barrier()
     else:
         raise RuntimeError("balancer failed to converge")
@@ -182,18 +230,40 @@ def _balance_one_set(file_paths, out_dir, num_shards, comm, postfix=""):
         assert s.num_samples == targets[s.idx], (
             "shard {} has {} != target {}".format(
                 s.idx, s.num_samples, targets[s.idx]))
+    return iterations
+
+
+def _balance_one_set(file_paths, out_dir, num_shards, comm, postfix="",
+                     stats=None):
+    """Balance one (possibly per-bin) file set into num_shards outputs."""
+    files = _census(file_paths, comm)
+    total = sum(f.num_samples for f in files)
+    if total < num_shards:
+        raise ValueError(
+            "cannot balance {} samples into {} shards; every shard must "
+            "receive at least one sample".format(total, num_shards))
+    targets = compute_targets(total, num_shards)
+
+    shards = [
+        _Shard(i, files[i::num_shards], out_dir, postfix=postfix, stats=stats)
+        for i in range(num_shards)
+    ]
+    _converge(shards, targets, comm)
 
     for s in shards:
         s.flush(i_am_owner=(s.idx % comm.world_size == comm.rank))
     comm.barrier()
-    return {os.path.basename(s.out_path): int(s.num_samples) for s in shards}
+    return {os.path.basename(s.out_path): int(s.final_file.num_samples)
+            for s in shards}
 
 
-def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None):
+def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None,
+                   stats=None):
     """Balance preprocessor output into ``num_shards`` equal shards (per bin
     when the input is binned). SPMD: call on every host with identical args.
 
     Returns {shard_basename: num_samples}; writes .num_samples.json.
+    Pass ``stats={}`` to collect the plan's I/O cost in rows (see _Shard).
     """
     comm = comm or LocalCommunicator()
     log = log or (lambda msg: None)
@@ -224,13 +294,17 @@ def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None):
             bin_paths = get_file_paths_for_bin_id(file_paths, b)
             counts.update(
                 _balance_one_set(bin_paths, out_dir, num_shards, comm,
-                                 postfix="_{}".format(b)))
+                                 postfix="_{}".format(b), stats=stats))
             log("balanced bin {}: {} files -> {} shards".format(
                 b, len(bin_paths), num_shards))
     else:
-        counts.update(_balance_one_set(file_paths, out_dir, num_shards, comm))
+        counts.update(_balance_one_set(file_paths, out_dir, num_shards, comm,
+                                       stats=stats))
         log("balanced {} files -> {} shards".format(
             len(file_paths), num_shards))
+    if stats is not None:
+        log("balance I/O (rows): {}".format(
+            {k: stats[k] for k in sorted(stats)}))
     if comm.rank == 0:
         write_num_samples_cache(out_dir, counts)
     comm.barrier()

@@ -19,7 +19,7 @@ import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import DATA_AXES, mesh_data_axes
+from ..parallel.mesh import data_axes_of, mesh_data_axes
 
 
 def _batch_block_of_device(device_shape, axis_names, coords, data_axes):
@@ -44,7 +44,7 @@ def dp_info_of_process(device_array, axis_names, process_index):
     every process.
     """
     axis_names = tuple(axis_names)
-    data_axes = tuple(a for a in axis_names if a in DATA_AXES)
+    data_axes = data_axes_of(axis_names)
     if not data_axes:
         return 0, 1
     blocks_by_process = {}

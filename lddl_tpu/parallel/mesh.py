@@ -67,9 +67,14 @@ def make_mesh(axis_sizes, devices=None):
     return Mesh(dev_array, axis_names=tuple(names))
 
 
+def data_axes_of(axis_names):
+    """The data axes among ``axis_names``, in given order."""
+    return tuple(a for a in axis_names if a in DATA_AXES)
+
+
 def mesh_data_axes(mesh):
     """The data axes present in this mesh, in mesh order."""
-    return tuple(a for a in mesh.axis_names if a in DATA_AXES)
+    return data_axes_of(mesh.axis_names)
 
 
 def data_parallel_size(mesh):
