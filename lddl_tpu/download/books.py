@@ -10,7 +10,7 @@ import tarfile
 
 from ..utils.args import attach_bool_arg
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
-from .utils import _ShardWriter, download, safe_extractall
+from .utils import download, safe_extractall, shard_files_parallel
 
 _URL = "https://the-eye.eu/public/AI/pile_preliminary_components/books1.tar.gz"
 
@@ -20,21 +20,21 @@ def untar(archive, outdir):
         safe_extractall(tf, outdir)
 
 
-def shard_books(books_dir, outdir, num_shards):
-    """Every .txt/.epub.txt under books_dir becomes one line; the doc id is
-    the book's filename (whitespace replaced)."""
-    writer = _ShardWriter(outdir, num_shards)
-    try:
-        for path in get_all_files_paths_under(books_dir):
-            if not path.endswith(".txt"):
-                continue
-            with open(path, encoding="utf-8", errors="replace") as f:
-                text = f.read()
-            book_id = os.path.basename(path).replace(" ", "-")
-            writer.write(book_id, text)
-    finally:
-        writer.close()
-    return writer.num_documents
+def parse_book_file(path):
+    """One book file -> one (doc_id, text); the doc id is the book's
+    filename (whitespace replaced)."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    yield os.path.basename(path).replace(" ", "-"), text
+
+
+def shard_books(books_dir, outdir, num_shards, num_processes=None):
+    """Book files round-robin to shards, one pool worker per shard
+    (ref: books.py:177-187)."""
+    paths = [p for p in get_all_files_paths_under(books_dir)
+             if p.endswith(".txt")]
+    return shard_files_parallel(paths, outdir, num_shards, parse_book_file,
+                                num_processes=num_processes)
 
 
 def attach_args(parser=None):
@@ -49,6 +49,9 @@ def attach_args(parser=None):
                              "(skips download+untar)")
     attach_bool_arg(parser, "download", default=True,
                     help_str="run the download step")
+    parser.add_argument("--number-of-sharding-processes", type=int, default=0,
+                        help="process-pool size for the sharding step "
+                             "(0 = cpu count)")
     return parser
 
 
@@ -62,7 +65,8 @@ def main(args=None):
             download(_URL, archive)
         books_dir = os.path.join(outdir, "books1")
         untar(archive, outdir)
-    n = shard_books(books_dir, outdir, args.num_shards)
+    n = shard_books(books_dir, outdir, args.num_shards,
+                    num_processes=args.number_of_sharding_processes)
     print("books: {} books -> {} shards".format(n, args.num_shards))
 
 

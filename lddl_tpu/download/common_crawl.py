@@ -1,13 +1,22 @@
 """Common Crawl news downloader.
 
 Reference parity: lddl/download/common_crawl.py, which wraps
-``news-please``'s commoncrawl crawler with language/date filters, streams
-articles into per-(pid, tid) buffer files flushed every
-``--articles-per-write``, and finally aggregates+shards. We keep the same
-architecture with the crawler gated behind the optional ``news-please``
-package, and support the same resumable multi-node prefix naming so
-several hosts can download concurrently into one directory and shard once
-at the end (ref: common_crawl.py:114-122,336-344).
+``news-please``'s commoncrawl crawler with language/date/host filters,
+streams articles into per-(pid, tid) buffer files flushed every
+``--articles-per-write``, and finally aggregates+shards with a process
+pool. We keep the same architecture with the crawler gated behind the
+optional ``news-please`` package, and support the same resumable
+multi-node prefix naming so several hosts can download concurrently into
+one directory and shard once at the end
+(ref: common_crawl.py:114-122,336-344).
+
+Flag parity with the reference CLI (common_crawl.py:100-260): article and
+WARC date windows with custom formats, --valid-hosts, --strict-date,
+--reuse-previously-downloaded-files, --continue-after-error,
+--show-download-progress, --delete-warc-after-extraction,
+--continue-process, --number-of-extraction-processes,
+--number-of-sharding-processes, and the skippable --newsplease/--shard
+steps.
 """
 
 import argparse
@@ -15,8 +24,9 @@ import os
 import threading
 import time
 
+from ..utils.args import attach_bool_arg
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
-from .utils import _ShardWriter
+from .utils import shard_files_parallel
 
 
 class ArticleBuffer:
@@ -59,8 +69,14 @@ class ArticleBuffer:
         state.warc_count += 1
 
 
-def crawl(outdir, prefix, start_date=None, end_date=None, language="en",
-          articles_per_write=1000, continue_process=True):
+def crawl(outdir, prefix, valid_hosts=(), start_date=None, end_date=None,
+          warc_files_start_date=None, warc_files_end_date=None,
+          strict_date=True, langs=("en",), articles_per_write=1000,
+          reuse_previously_downloaded_files=True, continue_after_error=True,
+          show_download_progress=False, delete_warc_after_extraction=True,
+          continue_process=True, number_of_extraction_processes=1):
+    """Stream Common Crawl news articles into buffer files under
+    ``<outdir>/txt`` (ref: common_crawl.py:454-483 for the kwargs)."""
     try:
         from newsplease.crawler import commoncrawl_crawler
     except ImportError as e:
@@ -70,9 +86,10 @@ def crawl(outdir, prefix, start_date=None, end_date=None, language="en",
             "pre-downloaded article files with --txt-dir") from e
     buffer = ArticleBuffer(os.path.join(outdir, "txt"), prefix,
                            articles_per_write)
+    langs = set(langs)
 
     def on_article(article):
-        if article.language is not None and article.language != language:
+        if article.language is not None and article.language not in langs:
             return
         text = article.maintext or ""
         if not text.strip():
@@ -83,32 +100,44 @@ def crawl(outdir, prefix, start_date=None, end_date=None, language="en",
         buffer.flush()
 
     commoncrawl_crawler.crawl_from_commoncrawl(
-        valid_hosts=[],
-        warc_files_start_date=start_date,
-        warc_files_end_date=end_date,
-        callback_on_article_extracted=on_article,
+        on_article,
         callback_on_warc_completed=on_warc,
-        continue_process=continue_process,
+        valid_hosts=list(valid_hosts),
+        start_date=start_date,
+        end_date=end_date,
+        warc_files_start_date=warc_files_start_date,
+        warc_files_end_date=warc_files_end_date,
+        strict_date=strict_date,
+        reuse_previously_downloaded_files=reuse_previously_downloaded_files,
         local_download_dir_warc=os.path.join(outdir, "warc"),
-        number_of_extraction_processes=1,
+        continue_after_error=continue_after_error,
+        show_download_progress=show_download_progress,
+        number_of_extraction_processes=number_of_extraction_processes,
+        delete_warc_after_extraction=delete_warc_after_extraction,
+        continue_process=continue_process,
+        fetch_images=False,
     )
     buffer.flush()
 
 
-def aggregate_txt(txt_dir, outdir, num_shards):
-    """Merge the streamed buffer files (one doc per line already) into the
-    standard round-robin source shards."""
-    writer = _ShardWriter(outdir, num_shards)
-    try:
-        for path in sorted(get_all_files_paths_under(txt_dir)):
-            with open(path, encoding="utf-8") as f:
-                for line in f:
-                    parts = line.rstrip("\n").split(None, 1)
-                    if len(parts) == 2:
-                        writer.write(parts[0], parts[1])
-    finally:
-        writer.close()
-    return writer.num_documents
+def parse_buffer_file(path):
+    """One streamed buffer file (one doc per line already) ->
+    (doc_id, text) pairs."""
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.rstrip("\n").split(None, 1)
+            if len(parts) == 2:
+                yield parts[0], parts[1]
+
+
+def aggregate_txt(txt_dir, outdir, num_shards, num_processes=None):
+    """Merge the streamed buffer files into the standard source shards,
+    one pool worker per shard (ref: common_crawl.py:406-427). Every file
+    under ``txt_dir`` is aggregated regardless of extension — the
+    --txt-dir workflow accepts externally-produced buffer files."""
+    return shard_files_parallel(
+        get_all_files_paths_under(txt_dir), outdir, num_shards,
+        parse_buffer_file, num_processes=num_processes)
 
 
 def attach_args(parser=None):
@@ -118,21 +147,63 @@ def attach_args(parser=None):
     parser.add_argument("--prefix", default="cc",
                         help="unique per host for multi-node downloads")
     parser.add_argument("--num-shards", type=int, default=256)
-    parser.add_argument("--start-date", default=None, help="YYYY-MM-DD")
-    parser.add_argument("--end-date", default=None, help="YYYY-MM-DD")
-    parser.add_argument("--language", default="en")
+    parser.add_argument("--valid-hosts", nargs="*", default=[],
+                        help="keep only articles from these hosts "
+                             "(default: any host)")
+    parser.add_argument("--start-date", default=None,
+                        help="keep only articles published after this date")
+    parser.add_argument("--start-date-format", default="%Y-%m-%d")
+    parser.add_argument("--end-date", default=None,
+                        help="keep only articles published before this date")
+    parser.add_argument("--end-date-format", default="%Y-%m-%d")
+    parser.add_argument("--warc-files-start-date", default=None,
+                        help="download only .warc files published after "
+                             "this date (controls download volume)")
+    parser.add_argument("--warc-files-start-date-format", default="%Y-%m-%d")
+    parser.add_argument("--warc-files-end-date", default=None,
+                        help="download only .warc files published before "
+                             "this date")
+    parser.add_argument("--warc-files-end-date-format", default="%Y-%m-%d")
+    parser.add_argument("--langs", nargs="+", default=["en"],
+                        help="keep only articles in these languages")
     parser.add_argument("--articles-per-write", type=int, default=1000)
+    parser.add_argument("--number-of-extraction-processes", type=int,
+                        default=os.cpu_count(),
+                        help="newsplease extraction process count")
+    parser.add_argument("--number-of-sharding-processes", type=int,
+                        default=0,
+                        help="process-pool size for the sharding step "
+                             "(0 = cpu count)")
+    attach_bool_arg(parser, "strict-date", default=True,
+                    help_str="discard articles whose published date could "
+                             "not be detected when date-filtering")
+    attach_bool_arg(parser, "reuse-previously-downloaded-files", default=True,
+                    help_str="skip .warc files already on disk (no "
+                             "completeness check)")
+    attach_bool_arg(parser, "continue-after-error", default=True,
+                    help_str="keep downloading when newsplease errors")
+    attach_bool_arg(parser, "show-download-progress", default=False,
+                    help_str="show .warc download progress")
+    attach_bool_arg(parser, "delete-warc-after-extraction", default=True,
+                    help_str="delete each .warc once extracted")
+    attach_bool_arg(parser, "continue-process", default=True,
+                    help_str="resume from fully-downloaded but not fully "
+                             "extracted .warc files (filters must not have "
+                             "changed)")
+    attach_bool_arg(parser, "newsplease", default=True,
+                    help_str="run the crawl step")
+    attach_bool_arg(parser, "shard", default=True,
+                    help_str="run the sharding step (multi-node: shard once "
+                             "after all hosts finish crawling)")
     parser.add_argument("--txt-dir", default=None,
-                        help="skip crawling; aggregate these buffer files")
-    parser.add_argument("--crawl-only", action="store_true",
-                        help="crawl without the final sharding (for "
-                             "multi-node: shard once after all hosts finish)")
+                        help="aggregate these buffer files instead of "
+                             "<outdir>/txt (implies --no-newsplease)")
     return parser
 
 
-def _parse_date(s):
+def _parse_date(s, fmt):
     import datetime
-    return None if s is None else datetime.datetime.strptime(s, "%Y-%m-%d")
+    return None if s is None else datetime.datetime.strptime(s, fmt)
 
 
 def main(args=None):
@@ -140,14 +211,36 @@ def main(args=None):
     outdir = expand_outdir_and_mkdir(args.outdir)
     txt_dir = args.txt_dir
     if txt_dir is None:
-        crawl(outdir, args.prefix,
-              start_date=_parse_date(args.start_date),
-              end_date=_parse_date(args.end_date),
-              language=args.language,
-              articles_per_write=args.articles_per_write)
         txt_dir = os.path.join(outdir, "txt")
-    if not args.crawl_only:
-        n = aggregate_txt(txt_dir, outdir, args.num_shards)
+        if args.newsplease:
+            crawl(
+                outdir, args.prefix,
+                valid_hosts=args.valid_hosts,
+                start_date=_parse_date(args.start_date,
+                                       args.start_date_format),
+                end_date=_parse_date(args.end_date, args.end_date_format),
+                warc_files_start_date=_parse_date(
+                    args.warc_files_start_date,
+                    args.warc_files_start_date_format),
+                warc_files_end_date=_parse_date(
+                    args.warc_files_end_date,
+                    args.warc_files_end_date_format),
+                strict_date=args.strict_date,
+                langs=args.langs,
+                articles_per_write=args.articles_per_write,
+                reuse_previously_downloaded_files=(
+                    args.reuse_previously_downloaded_files),
+                continue_after_error=args.continue_after_error,
+                show_download_progress=args.show_download_progress,
+                delete_warc_after_extraction=(
+                    args.delete_warc_after_extraction),
+                continue_process=args.continue_process,
+                number_of_extraction_processes=(
+                    args.number_of_extraction_processes),
+            )
+    if args.shard:
+        n = aggregate_txt(txt_dir, outdir, args.num_shards,
+                          num_processes=args.number_of_sharding_processes)
         print("common_crawl: {} articles -> {} shards".format(
             n, args.num_shards))
 

@@ -12,7 +12,7 @@ import os
 import tarfile
 
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
-from .utils import _ShardWriter, safe_extractall
+from .utils import safe_extractall, shard_files_parallel
 
 _DRIVE_ID = "1EA5V0oetDCOke7afsktL_JDQ-ETtNOvx"
 
@@ -48,18 +48,21 @@ def extract_archive(archive, outdir):
     return extracted
 
 
-def shard_pages(extracted_dir, outdir, num_shards):
-    writer = _ShardWriter(outdir, num_shards)
-    try:
-        for path in get_all_files_paths_under(extracted_dir):
-            if not path.endswith(".txt"):
-                continue
-            with open(path, encoding="utf-8", errors="replace") as f:
-                text = f.read()
-            writer.write(os.path.basename(path)[:-len(".txt")], text)
-    finally:
-        writer.close()
-    return writer.num_documents
+def parse_page_file(path):
+    """One page file -> one (doc_id, text); the doc id is the page
+    filename without extension."""
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    yield os.path.basename(path)[:-len(".txt")], text
+
+
+def shard_pages(extracted_dir, outdir, num_shards, num_processes=None):
+    """Page files round-robin to shards, one pool worker per shard
+    (ref: openwebtext.py:127-160)."""
+    paths = [p for p in get_all_files_paths_under(extracted_dir)
+             if p.endswith(".txt")]
+    return shard_files_parallel(paths, outdir, num_shards, parse_page_file,
+                                num_processes=num_processes)
 
 
 def attach_args(parser=None):
@@ -69,6 +72,9 @@ def attach_args(parser=None):
     parser.add_argument("--num-shards", type=int, default=256)
     parser.add_argument("--local-archive", default=None)
     parser.add_argument("--extracted-dir", default=None)
+    parser.add_argument("--number-of-sharding-processes", type=int, default=0,
+                        help="process-pool size for the sharding step "
+                             "(0 = cpu count)")
     return parser
 
 
@@ -79,7 +85,8 @@ def main(args=None):
     if extracted is None:
         archive = args.local_archive or fetch_from_drive(outdir)
         extracted = extract_archive(archive, outdir)
-    n = shard_pages(extracted, outdir, args.num_shards)
+    n = shard_pages(extracted, outdir, args.num_shards,
+                    num_processes=args.number_of_sharding_processes)
     print("openwebtext: {} pages -> {} shards".format(n, args.num_shards))
 
 

@@ -67,6 +67,18 @@ def download(url, path, chunk_size=16 * 1024 * 1024, progress=True):
     return path
 
 
+def format_doc_line(doc_id, text):
+    """One source line per document: ``<id> <flattened text>\\n``; None when
+    the text is empty after newline flattening."""
+    text = " ".join(text.split())
+    if not text:
+        return None
+    if any(c.isspace() for c in doc_id):
+        raise ValueError("doc id may not contain whitespace: "
+                         "{!r}".format(doc_id))
+    return doc_id + " " + text + "\n"
+
+
 class _ShardWriter:
     """Writes documents round-robin into ``<outdir>/source/<i>.txt``."""
 
@@ -84,15 +96,11 @@ class _ShardWriter:
         self._count = 0
 
     def write(self, doc_id, text):
-        # One line per document; newlines inside the doc flatten to spaces.
-        text = " ".join(text.split())
-        if not text:
+        line = format_doc_line(doc_id, text)
+        if line is None:
             return
-        if any(c.isspace() for c in doc_id):
-            raise ValueError("doc id may not contain whitespace: "
-                             "{!r}".format(doc_id))
         f = self._files[self._count % len(self._files)]
-        f.write(doc_id + " " + text + "\n")
+        f.write(line)
         self._count += 1
 
     def close(self):
@@ -102,6 +110,63 @@ class _ShardWriter:
     @property
     def num_documents(self):
         return self._count
+
+
+def _write_shard_from_files(shard_path, input_paths, parse_fn):
+    """Build ONE shard file from its assigned input files; returns the
+    document count. Top-level so process pools can pickle it."""
+    count = 0
+    with open(shard_path, "w", encoding="utf-8") as f:
+        for path in input_paths:
+            for doc_id, text in parse_fn(path):
+                line = format_doc_line(doc_id, text)
+                if line is not None:
+                    f.write(line)
+                    count += 1
+    return count
+
+
+def shard_files_parallel(input_paths, outdir, num_shards, parse_fn,
+                         num_processes=None, prefix=""):
+    """Reference-style parallel sharding (ref lddl/download/wikipedia.py:
+    77-85, books.py:177-187): input files are assigned round-robin to
+    shards and a process pool builds each shard file independently —
+    shard k = parse of ``input_paths[k::num_shards]``.
+
+    ``parse_fn(path)`` must be a picklable top-level callable yielding
+    (doc_id, text) pairs. Returns the total document count.
+    """
+    source_dir = os.path.join(outdir, "source")
+    os.makedirs(source_dir, exist_ok=True)
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    input_paths = sorted(input_paths)
+    if len(input_paths) < num_shards:
+        # Same behavior as the reference (empty shards are written), but
+        # say so: downstream block planning sees zero-byte inputs.
+        sys.stderr.write(
+            "warning: {} input files into {} shards leaves {} shard "
+            "file(s) empty; consider --num-shards <= input file count\n"
+            .format(len(input_paths), num_shards,
+                    num_shards - len(input_paths)))
+    shards = [
+        (os.path.join(source_dir, "{}{}.txt".format(prefix, k)),
+         input_paths[k::num_shards])
+        for k in range(num_shards)
+    ]
+    if num_processes is None or num_processes == 0:
+        num_processes = os.cpu_count() or 1
+    num_processes = min(num_processes, num_shards)
+    if num_processes <= 1:
+        return sum(_write_shard_from_files(p, fps, parse_fn)
+                   for p, fps in shards)
+    import concurrent.futures
+    import multiprocessing
+    with concurrent.futures.ProcessPoolExecutor(
+            max_workers=num_processes,
+            mp_context=multiprocessing.get_context("spawn")) as pool:
+        return sum(pool.map(_write_shard_from_files,
+                            *zip(*[(p, fps, parse_fn) for p, fps in shards])))
 
 
 def shard_documents(docs, outdir, num_shards):

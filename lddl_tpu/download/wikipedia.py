@@ -20,7 +20,7 @@ import sys
 
 from ..utils.args import attach_bool_arg
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
-from .utils import _ShardWriter, download
+from .utils import download, shard_files_parallel
 
 _URLS = {
     "en": "https://dumps.wikimedia.org/enwiki/latest/enwiki-latest-pages-articles.xml.bz2",
@@ -30,40 +30,43 @@ _URLS = {
 _DOC_OPEN = re.compile(r'<doc id="([^"]+)"[^>]*>')
 
 
-def aggregate_extracted(extracted_dir, outdir, num_shards, prefix=""):
-    """wikiextractor output -> source shards. Articles open with
-    ``<doc id=.. title=..>``, first content line repeats the title (dropped,
-    ref wikipedia.py:60-66), and close with ``</doc>``."""
-    writer = _ShardWriter(outdir, num_shards, prefix=prefix)
-    try:
-        for path in get_all_files_paths_under(extracted_dir):
-            with open(path, encoding="utf-8") as f:
-                doc_id = None
+def parse_wikiextractor_file(path):
+    """One wikiextractor output file -> (wiki-<id>, text) pairs. Articles
+    open with ``<doc id=.. title=..>``, first content line repeats the
+    title (dropped, ref wikipedia.py:60-66), and close with ``</doc>``."""
+    with open(path, encoding="utf-8") as f:
+        doc_id = None
+        lines = []
+        saw_title = False
+        for raw in f:
+            raw = raw.strip()
+            m = _DOC_OPEN.match(raw)
+            if m:
+                doc_id = m.group(1)
                 lines = []
                 saw_title = False
-                for raw in f:
-                    raw = raw.strip()
-                    m = _DOC_OPEN.match(raw)
-                    if m:
-                        doc_id = m.group(1)
-                        lines = []
-                        saw_title = False
-                        continue
-                    if raw == "</doc>":
-                        if doc_id is not None and lines:
-                            writer.write("wiki-" + doc_id, " ".join(lines))
-                        doc_id = None
-                        continue
-                    if doc_id is None:
-                        continue
-                    if not saw_title:
-                        saw_title = True  # first line is the title: drop
-                        continue
-                    if raw:
-                        lines.append(raw)
-    finally:
-        writer.close()
-    return writer.num_documents
+                continue
+            if raw == "</doc>":
+                if doc_id is not None and lines:
+                    yield "wiki-" + doc_id, " ".join(lines)
+                doc_id = None
+                continue
+            if doc_id is None:
+                continue
+            if not saw_title:
+                saw_title = True  # first line is the title: drop
+                continue
+            if raw:
+                lines.append(raw)
+
+
+def aggregate_extracted(extracted_dir, outdir, num_shards, prefix="",
+                        num_processes=None):
+    """wikiextractor output -> source shards, one pool worker per shard
+    (ref: wikipedia.py:77-85)."""
+    return shard_files_parallel(
+        get_all_files_paths_under(extracted_dir), outdir, num_shards,
+        parse_wikiextractor_file, num_processes=num_processes, prefix=prefix)
 
 
 def run_wikiextractor(dump_path, extracted_dir):
@@ -98,6 +101,9 @@ def attach_args(parser=None):
                     help_str="run the wikiextractor step")
     attach_bool_arg(parser, "shard", default=True,
                     help_str="run the sharding step")
+    parser.add_argument("--number-of-sharding-processes", type=int, default=0,
+                        help="process-pool size for the sharding step "
+                             "(0 = cpu count)")
     return parser
 
 
@@ -120,8 +126,9 @@ def main(args=None):
         if args.shard:
             # Per-language shard prefix: multiple --langs passes share one
             # outdir without overwriting each other.
-            n = aggregate_extracted(extracted, outdir, args.num_shards,
-                                    prefix=lang + "-")
+            n = aggregate_extracted(
+                extracted, outdir, args.num_shards, prefix=lang + "-",
+                num_processes=args.number_of_sharding_processes)
             print("wikipedia[{}]: {} articles -> {} shards".format(
                 lang, n, args.num_shards))
 

@@ -96,3 +96,78 @@ def test_common_crawl_buffer_and_aggregate(tmp_path):
     assert n == 5
     ids = {d for d, _ in _read_all_docs(out)}
     assert ids == {"cc-article-{}".format(i) for i in range(5)}
+
+
+def test_shard_files_parallel_pool_matches_sequential(tmp_path):
+    """The process-pool sharding path produces byte-identical shard files
+    to the sequential path (same file->shard assignment)."""
+    from lddl_tpu.download.utils import shard_files_parallel
+    from lddl_tpu.download.books import parse_book_file
+    books = tmp_path / "books"
+    books.mkdir()
+    paths = []
+    for i in range(11):
+        p = books / "book-{}.txt".format(i)
+        p.write_text("Text of book {}.\nSecond line.".format(i))
+        paths.append(str(p))
+    seq = str(tmp_path / "seq")
+    par = str(tmp_path / "par")
+    n1 = shard_files_parallel(paths, seq, 3, parse_book_file,
+                              num_processes=1)
+    n2 = shard_files_parallel(paths, par, 3, parse_book_file,
+                              num_processes=3)
+    assert n1 == n2 == 11
+    for k in range(3):
+        a = open(os.path.join(seq, "source", "{}.txt".format(k))).read()
+        b = open(os.path.join(par, "source", "{}.txt".format(k))).read()
+        assert a == b and a
+
+
+def test_common_crawl_cli_flag_parity():
+    """The CC CLI exposes the reference's full flag surface
+    (ref: lddl/download/common_crawl.py:100-260)."""
+    from lddl_tpu.download.common_crawl import attach_args
+    parser = attach_args()
+    args = parser.parse_args([
+        "--outdir", "/tmp/x",
+        "--valid-hosts", "example.com", "news.org",
+        "--start-date", "2020-01-01",
+        "--end-date", "2020-06-01",
+        "--warc-files-start-date", "2020-01-01",
+        "--warc-files-end-date", "2020-02-01",
+        "--langs", "en",
+        "--no-strict-date",
+        "--no-reuse-previously-downloaded-files",
+        "--no-continue-after-error",
+        "--show-download-progress",
+        "--no-delete-warc-after-extraction",
+        "--no-continue-process",
+        "--number-of-extraction-processes", "4",
+        "--number-of-sharding-processes", "2",
+        "--no-newsplease",
+    ])
+    assert args.valid_hosts == ["example.com", "news.org"]
+    assert not args.strict_date
+    assert not args.reuse_previously_downloaded_files
+    assert not args.continue_after_error
+    assert args.show_download_progress
+    assert not args.delete_warc_after_extraction
+    assert not args.continue_process
+    assert args.number_of_extraction_processes == 4
+    assert args.number_of_sharding_processes == 2
+    assert not args.newsplease and args.shard
+
+
+def test_common_crawl_no_newsplease_aggregates_outdir_txt(tmp_path):
+    """--no-newsplease skips the crawl but still shards <outdir>/txt."""
+    from lddl_tpu.download.common_crawl import attach_args, main
+    outdir = tmp_path / "cc"
+    txt = outdir / "txt"
+    txt.mkdir(parents=True)
+    (txt / "host-1-2-0-3.txt").write_text("cc-a Body a.\ncc-b Body b.\n")
+    args = attach_args().parse_args(
+        ["--outdir", str(outdir), "--num-shards", "2", "--no-newsplease",
+         "--number-of-sharding-processes", "1"])
+    main(args)
+    ids = {d for d, _ in _read_all_docs(str(outdir))}
+    assert ids == {"cc-a", "cc-b"}
