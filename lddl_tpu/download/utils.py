@@ -26,15 +26,27 @@ def safe_extractall(tf, outdir):
         target = os.path.realpath(os.path.join(base, m.name))
         if target != base and not target.startswith(base + os.sep):
             raise ValueError("unsafe tar member path: {}".format(m.name))
-        if m.issym() or m.islnk():
+        if m.issym():
+            # Symlink targets resolve relative to the member's directory.
             link = os.path.realpath(
                 os.path.join(os.path.dirname(target), m.linkname))
             if link != base and not link.startswith(base + os.sep):
                 raise ValueError(
                     "unsafe tar link target: {} -> {}".format(
                         m.name, m.linkname))
-        if m.isdev():
-            raise ValueError("device node in tar: {}".format(m.name))
+        elif m.islnk():
+            # Hardlink targets resolve relative to the archive root, like
+            # the 'data' filter does.
+            link = os.path.realpath(os.path.join(base, m.linkname))
+            if link != base and not link.startswith(base + os.sep):
+                raise ValueError(
+                    "unsafe tar hardlink target: {} -> {}".format(
+                        m.name, m.linkname))
+        elif not (m.isfile() or m.isdir()):
+            # FIFOs, device nodes, and any other special member types are
+            # rejected, matching filter="data".
+            raise ValueError(
+                "special tar member type rejected: {}".format(m.name))
     tf.extractall(outdir)
 
 

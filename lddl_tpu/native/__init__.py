@@ -81,6 +81,8 @@ def _load():
         lib.lddl_tok_create.argtypes = [ctypes.c_char_p, ctypes.c_int64,
                                         ctypes.c_int32, ctypes.c_int]
         lib.lddl_tok_free.argtypes = [ctypes.c_void_p]
+        lib.lddl_tok_set_memo_cap.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_int64]
         lib.lddl_tok_docs.restype = ctypes.POINTER(_TokResult)
         lib.lddl_tok_docs.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p,
@@ -121,15 +123,19 @@ class NativeTokenizer:
     fast). Not thread-safe; use one instance per worker process.
     """
 
-    def __init__(self, id_to_token, unk_id, do_lower_case=True):
+    def __init__(self, id_to_token, unk_id, do_lower_case=True,
+                 memo_cap=None):
         lib = _load()
         if lib is None:
             raise RuntimeError("native engine unavailable")
-        self._args = (list(id_to_token), int(unk_id), bool(do_lower_case))
+        self._args = (list(id_to_token), int(unk_id), bool(do_lower_case),
+                      memo_cap)
         self._lib = lib
         buf = "\n".join(id_to_token).encode("utf-8")
         self._handle = lib.lddl_tok_create(buf, len(buf), int(unk_id),
                                            1 if do_lower_case else 0)
+        if memo_cap is not None:
+            lib.lddl_tok_set_memo_cap(self._handle, int(memo_cap))
 
     def __reduce__(self):
         # ctypes handles cannot cross pickle boundaries; rebuild from the

@@ -26,10 +26,28 @@ def _stale(target, sources):
     return any(os.path.getmtime(s) > t for s in sources if os.path.exists(s))
 
 
+def _tables_stale():
+    """Tables are stale on mtime (generator changed) OR when the
+    calibration tag no longer matches this environment — the HF-side
+    semantics are probed from the installed ``tokenizers`` package, so a
+    header calibrated elsewhere (or via the unicodedata fallback) must be
+    regenerated to keep exact parity."""
+    if _stale(TABLES, [os.path.join(_DIR, "gen_tables.py")]):
+        return True
+    from . import gen_tables
+    want = "// calibration: " + gen_tables.calibration_tag()
+    try:
+        with open(TABLES) as f:
+            head = [next(f, "").strip() for _ in range(3)]
+    except OSError:
+        return True
+    return want not in head
+
+
 def ensure_built(verbose=False):
     """Build (if stale) and return the .so path, or None on failure."""
     try:
-        if _stale(TABLES, [os.path.join(_DIR, "gen_tables.py")]):
+        if _tables_stale():
             from . import gen_tables
             fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".h.tmp")
             os.close(fd)

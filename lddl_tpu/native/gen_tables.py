@@ -12,8 +12,10 @@ The C++ engine must match two Python-side semantics exactly:
 Rather than hand-porting Unicode behavior, we *evaluate the Python
 semantics per BMP codepoint* here and bake the answers into lookup tables,
 so the C++ side is table-driven and exact on the BMP. Codepoints above the
-BMP fall back to conservative procedural rules at runtime (rare in the
-target corpora: Wikipedia / books / news).
+BMP use sparse binary-searched tables (flag runs + non-identity folds)
+generated the same way, so astral Cf/Cc removal, astral punctuation
+isolation, and cased astral scripts (e.g. Deseret) also match
+BertTokenizerFast exactly.
 """
 
 import re
@@ -30,7 +32,10 @@ F_ALPHA = 64      # str.isalpha()
 
 _RE_SPACE = re.compile(r"\s")
 
-_CJK_BMP = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0xF900, 0xFAFF))
+# HF is_chinese_char ranges (BMP + astral extension blocks).
+_CJK = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0xF900, 0xFAFF),
+        (0x20000, 0x2A6DF), (0x2A700, 0x2B73F), (0x2B740, 0x2B81F),
+        (0x2B820, 0x2CEAF), (0x2F800, 0x2FA1F))
 
 # Unicode White_Space property (what Rust's char::is_whitespace — used by
 # the HF fast BertNormalizer — matches). NOTE: several of these are also
@@ -52,12 +57,15 @@ def _flags(cp):
     cat = unicodedata.category(c)
     if cp in _WHITE_SPACE:
         f |= F_HF_WS
-    if c not in "\t\n\r" and cat.startswith("C"):
+    # The HF fast normalizer (Rust) removes Cc/Cf/Co/Cs but KEEPS Cn
+    # (unassigned codepoints survive and join words — empirically probed:
+    # U+0378/U+FDD0/U+3FFFD stay, U+E000/U+100001/U+00AD are removed).
+    if c not in "\t\n\r" and cat in ("Cc", "Cf", "Co", "Cs"):
         f |= F_HF_CTRL
     if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
             or 123 <= cp <= 126 or cat.startswith("P")):
         f |= F_HF_PUNCT
-    if any(lo <= cp <= hi for lo, hi in _CJK_BMP):
+    if any(lo <= cp <= hi for lo, hi in _CJK):
         f |= F_CJK
     if c.isalpha():
         f |= F_ALPHA
@@ -73,8 +81,176 @@ def _fold_lower_strip(cp):
     return [ord(ch) for ch in s]
 
 
+_HF_BITS = F_HF_WS | F_HF_CTRL | F_HF_PUNCT | F_CJK
+
+
+def calibration_tag():
+    """Identifies the environment the HF-side tables were calibrated
+    against. Stamped into the generated header; build.py regenerates when
+    it no longer matches (e.g. tokenizers installed/upgraded after a
+    fallback build), so cached tables cannot silently lose parity."""
+    try:
+        import tokenizers
+        return "tokenizers=" + tokenizers.__version__
+    except Exception:
+        return "unicodedata=" + unicodedata.unidata_version
+
+# Codepoints never probed: surrogates (not valid scalars) and the probe
+# guard digits (digits are flag-free identity in every Unicode version).
+def _probe_skip(cp):
+    return 0xD800 <= cp <= 0xDFFF or 0x30 <= cp <= 0x39
+
+
+def _probe_rust_tables():
+    """Calibrate the HF-side behaviors (clean_text removal, whitespace,
+    CJK spacing, punctuation, fold output) against the INSTALLED Rust
+    ``tokenizers`` pipeline, per codepoint. Python's unicodedata and the
+    Rust crates can disagree by several Unicode versions (e.g. U+10EFD is
+    Mn in Unicode 15 but unknown to older Rust tables; Cn codepoints are
+    kept while Co are removed) — parity is defined against
+    BertTokenizerFast, so the installed Rust behavior wins. Returns
+    (flags: {cp: hf_bits}, folds: {cp: [out_cps]}) or None when the
+    ``tokenizers`` package is unavailable (unicodedata approximation is
+    used instead)."""
+    try:
+        from tokenizers.normalizers import BertNormalizer
+        from tokenizers.pre_tokenizers import BertPreTokenizer
+    except Exception:
+        return None
+    norm_plain = BertNormalizer(clean_text=True, handle_chinese_chars=True,
+                                strip_accents=False, lowercase=False)
+    norm_lower = BertNormalizer(clean_text=True, handle_chinese_chars=True,
+                                strip_accents=True, lowercase=True)
+    pre = BertPreTokenizer()
+    cps = [cp for cp in range(0x110000) if not _probe_skip(cp)]
+
+    def norm_probe(norm):
+        # "5<cp>7" groups: '5'/'7' survive every normalizer unchanged and
+        # no codepoint normalizes to a digit, so the segment between the
+        # guards is exactly cp's normalized expansion.
+        out = {}
+        chunk_size = 4096
+        for i in range(0, len(cps), chunk_size):
+            chunk = cps[i:i + chunk_size]
+            t = norm.normalize_str(
+                "".join("5" + chr(cp) + "7" for cp in chunk))
+            pos = 0
+            for cp in chunk:
+                assert t[pos] == "5", hex(cp)
+                nxt = t.index("7", pos + 1)
+                out[cp] = t[pos + 1:nxt]
+                pos = nxt + 1
+            assert pos == len(t)
+        return out
+
+    plain = norm_probe(norm_plain)
+    lower = norm_probe(norm_lower)
+
+    # Punctuation probe: BertPreTokenizer isolates punct codepoints.
+    # "5<cp>7." groups; '.' always splits, so each group parses to
+    # ["5<cp>7"] (not punct), ["5", <cp>, "7"] (punct), or ["5", "7"]
+    # (whitespace).
+    punct = {}
+    chunk_size = 4096
+    for i in range(0, len(cps), chunk_size):
+        chunk = cps[i:i + chunk_size]
+        toks = [t for t, _ in pre.pre_tokenize_str(
+            "".join("5" + chr(cp) + "7." for cp in chunk))]
+        j = 0
+        for cp in chunk:
+            c = chr(cp)
+            if toks[j] == "5" + c + "7":
+                punct[cp] = False
+                j += 1
+            elif toks[j] == "5" and toks[j + 1] == c and toks[j + 2] == "7":
+                punct[cp] = True
+                j += 3
+            elif toks[j] == "5" and toks[j + 1] == "7":
+                punct[cp] = False  # whitespace-split, not punctuation
+                j += 2
+            else:
+                raise AssertionError("unparseable punct probe at "
+                                     + hex(cp))
+            assert toks[j] == ".", hex(cp)
+            j += 1
+        assert j == len(toks)
+
+    flags = {}
+    folds = {}
+    for cp in cps:
+        nl = plain[cp]
+        f = 0
+        if nl == "":
+            f |= F_HF_CTRL
+        elif nl == " ":
+            f |= F_HF_WS
+        elif len(nl) >= 3 and nl[0] == " " and nl[-1] == " ":
+            assert nl == " " + chr(cp) + " ", hex(cp)
+            f |= F_CJK
+        if punct[cp]:
+            f |= F_HF_PUNCT
+        flags[cp] = f
+        if not (f & (F_HF_CTRL | F_HF_WS)):
+            lo = lower[cp]
+            if f & F_CJK:
+                assert lo[0] == " " and lo[-1] == " ", hex(cp)
+                lo = lo[1:-1]
+            fold = [ord(ch) for ch in lo]
+            if fold != [cp]:
+                assert len(fold) <= 3, hex(cp)
+                folds[cp] = fold
+    return flags, folds
+
+
+def _make_flags_fn():
+    """flags(cp): splitter bits (F_RE_SPACE/F_STR_SPACE/F_ALPHA) always
+    follow Python semantics (they mirror the Python splitter); HF bits
+    come from the Rust probe when available."""
+    probed = _probe_rust_tables()
+    if probed is None:
+        sys.stderr.write("gen_tables: tokenizers unavailable — using "
+                         "unicodedata approximation for HF semantics\n")
+        return _flags, _fold_lower_strip
+    pflags, pfolds = probed
+
+    def flags(cp):
+        f = _flags(cp)
+        if cp in pflags:
+            f = (f & ~_HF_BITS) | pflags[cp]
+        return f
+
+    def fold(cp):
+        return pfolds.get(cp, [cp])
+
+    return flags, fold
+
+
+def _astral_tables(flags_fn, fold_fn):
+    """Sparse tables for cp >= 0x10000: contiguous same-flag runs (binary
+    search by start) and non-identity fold entries (binary search by cp)."""
+    run_starts, run_flags = [], []
+    prev = None
+    for cp in range(0x10000, 0x110000):
+        f = flags_fn(cp)
+        if f != prev:
+            run_starts.append(cp)
+            run_flags.append(f)
+            prev = f
+    folds = []
+    for cp in range(0x10000, 0x110000):
+        out = fold_fn(cp)
+        if out != [cp]:
+            assert len(out) <= 3
+            padded = out + [0] * (3 - len(out))
+            folds.append((cp, len(out), padded[0], padded[1], padded[2]))
+    return run_starts, run_flags, folds
+
+
 def generate(out_path):
-    flags = [_flags(cp) for cp in range(0x10000)]
+    flags_fn, fold_fn = _make_flags_fn()
+    flags = [flags_fn(cp) for cp in range(0x10000)]
+    astral_starts, astral_flags, astral_folds = _astral_tables(flags_fn,
+                                                               fold_fn)
 
     # Fold table: only non-identity entries are materialized.
     fold_idx = [0xFFFF] * 0x10000
@@ -82,7 +258,7 @@ def generate(out_path):
     for cp in range(0x10000):
         if 0xD800 <= cp <= 0xDFFF:  # surrogates: not valid scalar values
             continue
-        out = _fold_lower_strip(cp)
+        out = fold_fn(cp)
         if out == [cp]:
             continue
         if len(out) > 3:  # no BMP codepoint folds to >3 under this pipeline
@@ -103,6 +279,7 @@ def generate(out_path):
 
     parts = [
         "// Auto-generated by gen_tables.py — do not edit.",
+        "// calibration: " + calibration_tag(),
         "#pragma once",
         "#include <cstdint>",
         "#define F_RE_SPACE {}".format(F_RE_SPACE),
@@ -117,6 +294,12 @@ def generate(out_path):
         dump("FOLD_N", "uint8_t", [e[0] for e in entries]),
         dump("FOLD_OUT", "uint32_t",
              [v for e in entries for v in (e[1], e[2], e[3])]),
+        dump("AFLAG_START", "uint32_t", astral_starts),
+        dump("AFLAG_VALUE", "uint8_t", astral_flags),
+        dump("AFOLD_CP", "uint32_t", [e[0] for e in astral_folds]),
+        dump("AFOLD_N", "uint8_t", [e[1] for e in astral_folds]),
+        dump("AFOLD_OUT", "uint32_t",
+             [v for e in astral_folds for v in (e[2], e[3], e[4])]),
     ]
     with open(out_path, "w") as f:
         f.write("\n".join(parts) + "\n")

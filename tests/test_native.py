@@ -206,3 +206,124 @@ def test_memoization_consistency(hf_tokenizer):
     again, lens_again, _ = nat.tokenize_docs([text])
     assert once.tolist() == again.tolist()
     assert lens_once.tolist() == lens_again.tolist()
+
+
+ASTRAL_DOCS = [
+    # Deseret (cased astral script): lowercases via the astral fold table.
+    "Deseret \U00010400\U00010401\U00010402 text. More \U00010428 here.",
+    # Astral punctuation (Aegean word separators) isolates like BMP punct.
+    "words\U00010100separated\U00010101here. Next one.",
+    # Astral Cf (musical format controls, tags) are removed by clean_text.
+    "musical\U0001D173note\U0001D17Ahere. tag\U000E0041chars\U000E007F gone.",
+    # SMP CJK extension B spaces like BMP CJK chars.
+    "ext\U00020000b\U0002A6D6chars. Done.",
+    # Math alphanumerics + emoji (no fold, not punct): grouped per HF rules.
+    "math \U0001D400\U0001D41A symbols. emoji \U0001F600 mixed\U0001F601in.",
+    # Plane-16 private use + unassigned astral codepoints.
+    "private \U00100001use. unassigned \U0003FFFD cp.",
+]
+
+
+def test_astral_tokenize_parity_vs_hf(hf_tokenizer):
+    """Above-BMP behavior matches BertTokenizerFast exactly: astral Cf/Cc
+    removal, astral punctuation isolation, cased astral scripts, SMP CJK
+    (ADVICE round 1: the old procedural fallback diverged here)."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    assert nat is not None
+    ids, sent_lens, doc_counts = nat.tokenize_docs(ASTRAL_DOCS)
+    backend = hf_tokenizer._tokenizer
+    k = 0
+    pos = 0
+    for d, text in enumerate(ASTRAL_DOCS):
+        kept = 0
+        for s in split_sentences(text):
+            ref = backend.encode(s, add_special_tokens=False).ids
+            if not ref:
+                continue
+            n = int(sent_lens[k])
+            assert ids[pos:pos + n].tolist() == ref, repr(s)
+            k += 1
+            pos += n
+            kept += 1
+        assert int(doc_counts[d]) == kept
+    assert k == len(sent_lens) and pos == len(ids)
+
+
+def test_memo_cap_does_not_change_results(hf_tokenizer):
+    """A tokenizer whose memo never admits entries (cap=0 -> every word
+    recomputes) produces identical ids: the cap only bounds memory."""
+    import numpy as np
+    from lddl_tpu.native import NativeTokenizer
+    info = TokenizerInfo(hf_tokenizer)
+    id_to_token = [hf_tokenizer.convert_ids_to_tokens(i)
+                   for i in range(len(hf_tokenizer))]
+    unk = hf_tokenizer.convert_tokens_to_ids("[UNK]")
+    default = NativeTokenizer(id_to_token, unk)
+    capped = NativeTokenizer(id_to_token, unk, memo_cap=0)
+    ids1, lens1, counts1 = default.tokenize_docs(DOCS * 2)
+    ids2, lens2, counts2 = capped.tokenize_docs(DOCS * 2)
+    np.testing.assert_array_equal(ids1, ids2)
+    np.testing.assert_array_equal(lens1, lens2)
+    np.testing.assert_array_equal(counts1, counts2)
+
+
+def test_unassigned_codepoints_kept_like_hf(hf_tokenizer):
+    """Cn (unassigned) codepoints survive normalization and join words —
+    Cc/Cf/Co are removed (probed against the Rust normalizer)."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    docs = ["a͸b stays. a­b removed. a\U0003FFFDb astral. "
+            "a\U00100001b private."]
+    ids, sent_lens, _ = nat.tokenize_docs(docs)
+    backend = hf_tokenizer._tokenizer
+    pos = 0
+    for k, s in enumerate(split_sentences(docs[0])):
+        ref = backend.encode(s, add_special_tokens=False).ids
+        n = int(sent_lens[k])
+        assert ids[pos:pos + n].tolist() == ref, repr(s)
+        pos += n
+
+
+def test_fuzz_unicode_parity_vs_hf(hf_tokenizer):
+    """Random unicode soup (all planes, no surrogates) tokenizes
+    identically to BertTokenizerFast."""
+    import numpy as np
+    g = np.random.default_rng(17)
+    pools = [
+        (0x20, 0x7F), (0xA0, 0x600), (0x1E00, 0x2100), (0x3000, 0xA000),
+        (0xF900, 0x10000), (0x10000, 0x11000), (0x16000, 0x17000),
+        (0x1D100, 0x1D800), (0x1E000, 0x1F000), (0x20000, 0x20100),
+        (0x2F800, 0x2FA20), (0xE0000, 0xE0200), (0xF0000, 0xF0100),
+        (0x10F000, 0x110000),
+    ]
+    docs = []
+    for _ in range(60):
+        cps = []
+        for _ in range(int(g.integers(5, 60))):
+            lo, hi = pools[int(g.integers(0, len(pools)))]
+            cp = int(g.integers(lo, hi))
+            if 0xD800 <= cp <= 0xDFFF:
+                cp = 0x61
+            cps.append(cp)
+            if g.random() < 0.2:
+                cps.append(0x20)
+        docs.append("".join(map(chr, cps)) + ".")
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    ids, sent_lens, doc_counts = nat.tokenize_docs(docs)
+    backend = hf_tokenizer._tokenizer
+    k = 0
+    pos = 0
+    for d, text in enumerate(docs):
+        kept = 0
+        for s in split_sentences(text):
+            ref = backend.encode(s, add_special_tokens=False).ids
+            if not ref:
+                continue
+            n = int(sent_lens[k])
+            assert ids[pos:pos + n].tolist() == ref, repr(s)
+            k += 1
+            pos += n
+            kept += 1
+        assert int(doc_counts[d]) == kept
