@@ -73,7 +73,7 @@ def _load():
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.
         try:
-            if lib.lddl_native_abi_version() != 2:
+            if lib.lddl_native_abi_version() != 3:
                 return None
         except AttributeError:
             return None

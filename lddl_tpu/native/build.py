@@ -44,34 +44,60 @@ def _tables_stale():
     return want not in head
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _build_lock():
+    """Cross-process exclusive lock: table generation is a multi-second
+    full-Unicode probe, so an N-worker pool must generate once, not N
+    times concurrently. Blocks until the winner finishes; losers then see
+    fresh tables and skip regeneration."""
+    path = os.path.join(_DIR, ".build.lock")
+    try:
+        import fcntl
+        with open(path, "w") as f:
+            fcntl.flock(f, fcntl.LOCK_EX)
+            try:
+                yield
+            finally:
+                fcntl.flock(f, fcntl.LOCK_UN)
+    except ImportError:  # non-POSIX: fall back to the atomic os.replace race
+        yield
+
+
 def ensure_built(verbose=False):
     """Build (if stale) and return the .so path, or None on failure."""
     try:
-        if _tables_stale():
-            from . import gen_tables
-            fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".h.tmp")
-            os.close(fd)
-            try:
-                gen_tables.generate(tmp)
-                os.replace(tmp, TABLES)
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-        if _stale(LIB, [SRC, TABLES]):
-            fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
-            os.close(fd)
-            try:
-                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                       SRC, "-o", tmp]
-                proc = subprocess.run(cmd, capture_output=True, text=True)
-                if proc.returncode != 0:
-                    if verbose:
-                        print("native build failed:\n" + proc.stderr)
-                    return None
-                os.replace(tmp, LIB)  # atomic: concurrent builders race
-            finally:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
+        if not _tables_stale() and not _stale(LIB, [SRC, TABLES]):
+            return LIB
+        with _build_lock():
+            # Re-check under the lock: another process may have finished.
+            if _tables_stale():
+                from . import gen_tables
+                fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".h.tmp")
+                os.close(fd)
+                try:
+                    gen_tables.generate(tmp)
+                    os.replace(tmp, TABLES)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            if _stale(LIB, [SRC, TABLES]):
+                fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
+                os.close(fd)
+                try:
+                    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                           SRC, "-o", tmp]
+                    proc = subprocess.run(cmd, capture_output=True, text=True)
+                    if proc.returncode != 0:
+                        if verbose:
+                            print("native build failed:\n" + proc.stderr)
+                        return None
+                    os.replace(tmp, LIB)  # atomic
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
         return LIB
     except Exception as e:  # missing g++, read-only fs, ...
         if verbose:

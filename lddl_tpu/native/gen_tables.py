@@ -89,11 +89,15 @@ def calibration_tag():
     against. Stamped into the generated header; build.py regenerates when
     it no longer matches (e.g. tokenizers installed/upgraded after a
     fallback build), so cached tables cannot silently lose parity."""
+    # unicodedata always contributes (splitter bits F_RE_SPACE/F_STR_SPACE/
+    # F_ALPHA are baked from it), so a Python/Unicode upgrade regenerates
+    # even when the tokenizers version is unchanged.
+    tag = "unicodedata=" + unicodedata.unidata_version
     try:
         import tokenizers
-        return "tokenizers=" + tokenizers.__version__
+        return "tokenizers=" + tokenizers.__version__ + ";" + tag
     except Exception:
-        return "unicodedata=" + unicodedata.unidata_version
+        return tag
 
 # Codepoints never probed: surrogates (not valid scalars) and the probe
 # guard digits (digits are flag-free identity in every Unicode version).

@@ -63,6 +63,12 @@ def _looks_like_abbreviation(left):
         return True
     if re.fullmatch(r"(?:[A-Za-z]\.)+[A-Za-z]?", core):
         return True
+    # Bare list enumerator opening the piece ("2. Grant of License."):
+    # glue it to the sentence it numbers. <= 3 digits so a sentence
+    # starting with a bare year still splits.
+    if (core.isdigit() and len(core) <= 3 and core.isascii()
+            and left.strip() == word):
+        return True
     return core.lower() in _ABBREVIATIONS
 
 

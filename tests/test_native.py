@@ -67,7 +67,13 @@ def test_split_parity():
 def test_split_parity_no_boundary_cases():
     cases = ["", ".", "...", "a.", "a. b", "a. B", '"a." B said.',
              "x!? Y", "e.g. something", "i.e. another", "No. 5 ranked",
-             "end.)  Next", "end.” Next", "A.B.C. Next"]
+             "end.)  Next", "end.” Next", "A.B.C. Next",
+             # enumerators glue forward; years and mid-sentence numbers
+             # still split
+             "2. Grant of License. Subject to terms.",
+             "It was chapter 2. Next sentence here.",
+             "1999. The war ended.", "  10. Item ten. Done.",
+             "123. Deep item. 1234. Year-like."]
     got = native.split_docs(cases)
     for text, sents in zip(cases, got):
         assert sents == split_sentences(text), repr(text)
