@@ -1,0 +1,93 @@
+"""Isolated masking-kernel benchmark: numpy host kernel vs jit'd JAX.
+
+Answers the round-1 verdict question ("put the TPU in the hot path — or
+prove it shouldn't be") with a measurement: per-chunk wall time and
+rows/s for the static-masking kernel at bench-realistic shapes, on
+whatever backend JAX resolves (the real TPU chip under the driver; CPU
+when forced).
+
+Writes MASK_ENGINE_BENCH.json at the repo root.
+
+Usage: python benchmarks/mask_engine_bench.py [--rows-log2 8 15]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np
+
+
+def _inputs(n, width, vocab, seed):
+    g = np.random.default_rng(seed)
+    lens = g.integers(8, width, n)
+    ids = g.integers(10, vocab, (n, width)).astype(np.int32)
+    valid = np.arange(width)[None, :] < lens[:, None]
+    candidate = valid.copy()
+    candidate[:, 0] = False
+    from lddl_tpu.ops import plan_num_to_predict
+    num = plan_num_to_predict(lens, 0.15, 76)
+    return ids, candidate, num
+
+
+def _time(fn, *args, reps=5):
+    fn(*args)  # warm (includes any jit compile)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn(*args)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--rows-log2", type=int, nargs=2, default=(8, 15))
+    p.add_argument("--width", type=int, default=128)
+    p.add_argument("--vocab", type=int, default=30522)
+    p.add_argument("--out", default=os.path.join(ROOT,
+                                                 "MASK_ENGINE_BENCH.json"))
+    args = p.parse_args()
+
+    import jax
+    from lddl_tpu.ops import make_jax_masker, mask_batch_numpy
+    from lddl_tpu.utils import rng as lrng
+
+    backend = jax.devices()[0].platform
+    masker = make_jax_masker(103, args.vocab)
+    results = []
+    for log2 in range(args.rows_log2[0], args.rows_log2[1] + 1):
+        n = 1 << log2
+        ids, candidate, num = _inputs(n, args.width, args.vocab, seed=log2)
+
+        def run_numpy():
+            mask_batch_numpy(ids, candidate, num, lrng.sample_rng(1, log2),
+                             103, args.vocab)
+
+        def run_jax():
+            masker(ids, candidate, num, seed=log2)
+
+        t_np = _time(run_numpy)
+        t_jx = _time(run_jax)
+        results.append({
+            "rows": n,
+            "width": args.width,
+            "numpy_ms": round(t_np * 1e3, 3),
+            "jax_ms": round(t_jx * 1e3, 3),
+            "numpy_rows_per_s": round(n / t_np),
+            "jax_rows_per_s": round(n / t_jx),
+            "jax_speedup": round(t_np / t_jx, 3),
+        })
+        print(results[-1], flush=True)
+
+    payload = {"jax_backend": backend, "results": results}
+    with open(args.out, "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -49,7 +49,14 @@ class BertPretrainConfig:
     max_predictions_per_seq: int = None  # default: ceil(ratio * max_seq_len)
     whole_word_masking: bool = False
     duplicate_factor: int = 5
-    engine: str = "numpy"  # masking kernel: "numpy" | "jax"
+    # Masking kernel: "numpy" | "jax". numpy is the MEASURED default: on a
+    # real TPU chip the jit'd kernel is 10-100x slower than the host numpy
+    # kernel at every bucket size (dispatch latency + host<->device
+    # transfer dominate this trivially-parallel int32 work; see
+    # benchmarks/mask_engine_bench.py, recorded in MASK_ENGINE_BENCH.json).
+    # The offline pipeline keeps the TPU free for training; the jax kernel
+    # remains for device-resident data paths.
+    engine: str = "numpy"
     # Sentence-split + tokenize engine: "native" = the C++ one-pass kernel
     # (lddl_tpu.native), "hf" = Python splitter + HF fast tokenizer,
     # "auto" = native when buildable + tokenizer-compatible, else hf.
