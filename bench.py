@@ -115,7 +115,9 @@ def make_corpus(out_root, target_mb, shards=4, seed=0, n_types=30000,
 
 
 def _timed_run(corpus_dir, corpus_bytes, out_dir, tokenizer, *,
-               tokenizer_engine, mask_engine, num_workers):
+               tokenizer_engine, mask_engine, num_workers, num_blocks=None):
+    if num_blocks is None:
+        num_blocks = max(8, 2 * (num_workers or 1))
     from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
     t0 = time.time()
     written = run_bert_preprocess(
@@ -125,7 +127,7 @@ def _timed_run(corpus_dir, corpus_bytes, out_dir, tokenizer, *,
         config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
                                   masking=True, engine=mask_engine,
                                   tokenizer_engine=tokenizer_engine),
-        num_blocks=max(8, 2 * (num_workers or 1)),
+        num_blocks=num_blocks,
         sample_ratio=1.0,
         seed=12345,
         bin_size=32,
@@ -185,7 +187,8 @@ def main():
                     small_corpus, small_bytes,
                     os.path.join(tmp, "out_" + name.replace("+", "_")),
                     tokenizer, tokenizer_engine=tok_eng, mask_engine=mask_eng,
-                    num_workers=n_workers)
+                    num_workers=n_workers,
+                    num_blocks=max(8, 2 * workers))
                 variants[name] = round(v, 4)
             except Exception as e:  # variant failure must not kill the bench
                 variants[name] = "error: {}".format(e)

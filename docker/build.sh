@@ -1,0 +1,16 @@
+#!/bin/bash
+# Build the lddl_tpu image (ref: docker/build.sh).
+#   docker/build.sh [tag] [jax_extra]
+#   jax_extra: tpu (default) | cpu  — cpu for preprocess-only clusters.
+set -e
+TAG=${1:-"lddl-tpu:latest"}
+JAX_EXTRA=${2:-"tpu"}
+
+docker build \
+  -f docker/tpu.Dockerfile \
+  --network=host \
+  --rm \
+  -t "${TAG}" \
+  --build-arg JAX_EXTRA="${JAX_EXTRA}" \
+  .
+echo "built ${TAG}"

@@ -1,0 +1,19 @@
+#!/bin/bash
+# Interactive shell in the lddl_tpu image (ref: docker/interactive.sh).
+#   docker/interactive.sh ["-v /data:/data ..."] [cmd] [image]
+# --privileged + /dev exposure are what TPU VM runtimes need to reach the
+# accelerator; preprocess-only runs can drop both.
+MOUNTS=$1
+CMD=${2:-"bash"}
+IMAGE=${3:-"lddl-tpu:latest"}
+
+docker run \
+  --init \
+  -it \
+  --rm \
+  --network=host \
+  --privileged \
+  -v "$PWD":/workspace/lddl_tpu \
+  ${MOUNTS} \
+  "${IMAGE}" \
+  ${CMD}

@@ -1,0 +1,32 @@
+# lddl_tpu container for TPU VM hosts (and CPU-only preprocess clusters).
+# Mirrors the reference's docker/ngc_pyt.Dockerfile role
+# (ref: docker/ngc_pyt.Dockerfile): a pinned, reproducible environment for
+# the pod recipe (examples/tpu_pod_example.sh).
+#
+# Base: a plain Python image — JAX with TPU support installs from the
+# libtpu releases; there is no vendor base image requirement on TPU VMs.
+ARG PYTHON_TAG=3.12-slim-bookworm
+FROM python:${PYTHON_TAG}
+
+ENV LANG=C.UTF-8 \
+    LC_ALL=C.UTF-8 \
+    PIP_NO_CACHE_DIR=1
+
+# g++ builds the native tokenize engine on first use (lddl_tpu.native).
+RUN apt-get update -qq && \
+    apt-get install -y --no-install-recommends g++ git && \
+    rm -rf /var/lib/apt/lists/*
+
+WORKDIR /workspace/lddl_tpu
+ADD . .
+
+# TPU hosts: jax[tpu]; CPU-only preprocess clusters can override
+# JAX_EXTRA=cpu at build time (smaller install, same APIs).
+ARG JAX_EXTRA=tpu
+RUN pip install -r docker/requirements.lock && \
+    pip install "jax[${JAX_EXTRA}]" && \
+    pip install ./
+
+# Pre-build the native engine + Unicode tables so first use in the pod
+# does not pay the build cost per worker.
+RUN python -m lddl_tpu.native.build

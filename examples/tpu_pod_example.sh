@@ -26,6 +26,10 @@
 # Storage: $DATA must be shared across hosts (GCS via gcsfuse, or NFS) --
 # the same mount that serves the training shards. The preprocessor's
 # shuffle spool and the balancer's ownership-striped I/O ride on it.
+#
+# Reproducible environment: docker/tpu.Dockerfile (pinned deps in
+# docker/requirements.lock); build with docker/build.sh and run this
+# script inside, or pip-install the same pins directly on the hosts.
 set -euo pipefail
 
 DATA=${DATA:-/tmp/lddl_tpu_pod_example}

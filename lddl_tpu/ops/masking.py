@@ -16,7 +16,7 @@ jax.random), so masks differ between engines but are each fully
 deterministic in (seed, bucket). Shard parity is defined per engine.
 
 Engine choice is a measured decision, not a guess: on a real TPU chip the
-jax kernel loses to host numpy by 10-100x at every bucket size (dispatch
+jax kernel loses to host numpy by 9-111x across bucket sizes 256..32k rows (dispatch
 latency + host<->device transfer dominate; benchmarks/mask_engine_bench.py
 -> MASK_ENGINE_BENCH.json), so "numpy" is the preprocessing default and
 the jit kernels serve device-resident data paths.

@@ -50,8 +50,8 @@ class BertPretrainConfig:
     whole_word_masking: bool = False
     duplicate_factor: int = 5
     # Masking kernel: "numpy" | "jax". numpy is the MEASURED default: on a
-    # real TPU chip the jit'd kernel is 10-100x slower than the host numpy
-    # kernel at every bucket size (dispatch latency + host<->device
+    # real TPU chip the jit'd kernel is 9-111x slower than the host numpy
+    # kernel across bucket sizes 256..32k rows (dispatch latency + host<->device
     # transfer dominate this trivially-parallel int32 work; see
     # benchmarks/mask_engine_bench.py, recorded in MASK_ENGINE_BENCH.json).
     # The offline pipeline keeps the TPU free for training; the jax kernel
