@@ -167,6 +167,18 @@ def main():
             sample, os.path.join(tmp, "vocab.txt"), vocab_size=30522)
         tokenizer = get_tokenizer(vocab_file=vocab)
 
+        # Warmup on a 1 MB slice: pays the once-per-process costs (imports,
+        # native engine build/check, tokenizer byte tables) outside the
+        # timed window, so the headline measures steady-state throughput —
+        # the regime the 12.5 GB north-star run lives in. (Pool spawn is
+        # NOT excluded: each run creates its own pool, and the headline
+        # keeps that cost, as the reference keeps its dask-mpi startup.)
+        warm_corpus = os.path.join(tmp, "corpus_warm")
+        warm_bytes, _ = make_corpus(warm_corpus, 1, seed=2)
+        _timed_run(warm_corpus, warm_bytes, os.path.join(tmp, "out_warm"),
+                   tokenizer, tokenizer_engine="auto", mask_engine="numpy",
+                   num_workers=workers)
+
         # Headline: the CLI-default configuration (native tokenizer engine
         # when available, numpy masking, full-host process pool).
         value, n_samples = _timed_run(

@@ -95,6 +95,7 @@ class TokenizerInfo:
         self.do_lower_case = bool(getattr(tokenizer, "do_lower_case", True))
         self.vocab_size = size
         self._native = None
+        self._token_bytes = None
         # Random-replacement masking draws from the full vocab (matching
         # Google's create_pretraining_data); the subword table supports
         # whole-word masking.
@@ -126,6 +127,20 @@ class TokenizerInfo:
 
     def join(self, ids):
         return " ".join(self.id_to_token[np.asarray(ids, dtype=np.int64)])
+
+    def token_byte_table(self):
+        """(spaced_table, lens): per-id UTF-8 bytes — plain at 2*id,
+        space-prefixed at 2*id+1 — plus per-id byte lengths, for the
+        vectorized Arrow column builders (preprocess.arrowcols)."""
+        if self._token_bytes is None:
+            enc = [t.encode("utf-8") for t in self.token_list]
+            lens = np.fromiter(map(len, enc), dtype=np.int64, count=len(enc))
+            spaced = []
+            for b in enc:
+                spaced.append(b)
+                spaced.append(b" " + b)
+            self._token_bytes = (spaced, lens)
+        return self._token_bytes
 
     def native_tokenizer(self):
         """Cached C++ engine instance, or None when unavailable or the
@@ -566,62 +581,81 @@ def _get_jax_wwm_masker(tok_info):
     return _JAX_WWM_MASKERS[key]
 
 
-def materialize_rows(batch, config, tok_info, seed, scope):
+def materialize_columns(batch, config, tok_info, seed, scope):
     """Instances (InstanceBatch or list of (a, b, is_random_next)) ->
-    parquet row dicts (strings), applying static masking batch-wise when
-    configured. String materialization is batched: one object-array gather
-    over the whole bucket, then plain list joins."""
+    parquet COLUMNS ({name: ndarray-or-pa.Array}, n), applying static
+    masking batch-wise when configured.
+
+    Columnar end-to-end: the string/binary columns are assembled as raw
+    Arrow buffers with vectorized byte gathers (preprocess.arrowcols) —
+    between pair construction and the parquet file, no per-row Python
+    object exists at all."""
+    from .arrowcols import (concat_aranges, joined_token_strings,
+                            serialized_u16_binary)
     if isinstance(batch, list):
         batch = InstanceBatch.from_pairs(batch, tok_info.cls_id,
                                          tok_info.sep_id)
     n = len(batch)
     if n == 0:
-        return []
-    a_lens, seq_lens = batch.a_lens, batch.seq_lens
+        return {}, 0
+    spaced_table, tok_lens = tok_info.token_byte_table()
+    a_lens = np.asarray(batch.a_lens, dtype=np.int64)
+    seq_lens = np.asarray(batch.seq_lens, dtype=np.int64)
+    b_lens = seq_lens - a_lens - 3
     rn = batch.is_random_next
 
     if not config.masking:
-        tl = tok_info.token_list
-        flat = batch.seq_ids.tolist()
-        offsets = np.zeros(n + 1, dtype=np.int64)
-        np.cumsum(seq_lens, out=offsets[1:])
-        rows = []
-        for i in range(n):
-            o = int(offsets[i])
-            la = int(a_lens[i])
-            end = int(seq_lens[i])
-            rows.append({
-                "A": " ".join([tl[t] for t in flat[o + 1:o + 1 + la]]),
-                "B": " ".join([tl[t] for t in flat[o + 2 + la:o + end - 1]]),
-                "is_random_next": bool(rn[i]),
-                "num_tokens": end,
-            })
-        return rows
+        # Row i of seq_ids spans [off_i, off_i + seq_lens_i):
+        # [CLS] A [SEP] B [SEP]. Gather A and B id segments flat.
+        offsets = np.cumsum(seq_lens) - seq_lens
+        flat_a = batch.seq_ids[np.repeat(offsets + 1, a_lens)
+                               + concat_aranges(a_lens)]
+        flat_b = batch.seq_ids[np.repeat(offsets + 2 + a_lens, b_lens)
+                               + concat_aranges(b_lens)]
+        return {
+            "A": joined_token_strings(flat_a, a_lens, spaced_table,
+                                      tok_lens),
+            "B": joined_token_strings(flat_b, b_lens, spaced_table,
+                                      tok_lens),
+            "is_random_next": np.asarray(rn, dtype=bool),
+            "num_tokens": seq_lens.astype(np.uint16),
+        }, n
 
     masked, selected, ids, a_lens, seq_lens = apply_static_masking(
         batch, config, tok_info, seed, scope)
-    width = int(seq_lens.max())
-    tok_rows = tok_info.id_to_token[masked[:, :width]].tolist()
+    a_lens = np.asarray(a_lens, dtype=np.int64)
+    seq_lens = np.asarray(seq_lens, dtype=np.int64)
+    b_lens = seq_lens - a_lens - 3
+    rows = np.arange(n, dtype=np.int64)
+    flat_a = masked[np.repeat(rows, a_lens),
+                    1 + concat_aranges(a_lens)]
+    flat_b = masked[np.repeat(rows, b_lens),
+                    np.repeat(2 + a_lens, b_lens) + concat_aranges(b_lens)]
     sel_rows, sel_cols = np.nonzero(selected)            # row-major: sorted
-    label_toks = tok_info.id_to_token[ids[sel_rows, sel_cols]].tolist()
-    positions = sel_cols.astype(np.uint16)
-    offsets = np.zeros(n + 1, dtype=np.int64)
-    np.cumsum(np.bincount(sel_rows, minlength=n), out=offsets[1:])
-    rows = []
-    for i in range(n):
-        la = int(a_lens[i])
-        end = int(seq_lens[i])
-        trow = tok_rows[i]
-        s, e = int(offsets[i]), int(offsets[i + 1])
-        rows.append({
-            "A": " ".join(trow[1:1 + la]),
-            "B": " ".join(trow[2 + la:end - 1]),
-            "is_random_next": bool(rn[i]),
-            "num_tokens": end,
-            "masked_lm_positions": serialize_np_array(positions[s:e]),
-            "masked_lm_labels": " ".join(label_toks[s:e]),
-        })
-    return rows
+    sel_lens = np.bincount(sel_rows, minlength=n)
+    return {
+        "A": joined_token_strings(flat_a, a_lens, spaced_table, tok_lens),
+        "B": joined_token_strings(flat_b, b_lens, spaced_table, tok_lens),
+        "is_random_next": np.asarray(rn, dtype=bool),
+        "num_tokens": seq_lens.astype(np.uint16),
+        "masked_lm_positions": serialized_u16_binary(sel_cols, sel_lens),
+        "masked_lm_labels": joined_token_strings(
+            ids[sel_rows, sel_cols], sel_lens, spaced_table, tok_lens),
+    }, n
+
+
+def materialize_rows(batch, config, tok_info, seed, scope):
+    """Row-dict view of materialize_columns (debug/txt sink + tests; the
+    parquet path consumes the columns directly)."""
+    import pyarrow as pa
+    columns, n = materialize_columns(batch, config, tok_info, seed, scope)
+    plain = {
+        name: (col.to_pylist() if isinstance(col, pa.Array)
+               else col.tolist())
+        for name, col in columns.items()
+    }
+    names = list(plain)
+    return [{name: plain[name][i] for name in names} for i in range(n)]
 
 
 # Backwards-compatible helper used by tests and docs: per-sequence masking

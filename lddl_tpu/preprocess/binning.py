@@ -17,6 +17,7 @@ bounded by nbins (SURVEY.md §5 "Long-context").
 
 import os
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
@@ -43,7 +44,9 @@ def num_bins(target_seq_length, bin_size):
 
 
 def bin_id_of_num_tokens(num_tokens, bin_size, nbins):
-    return min(max(num_tokens - 1, 0) // bin_size, nbins - 1)
+    """Scalar or ndarray; the ONE definition of the bin formula (loader,
+    balancer, and both sink paths must agree)."""
+    return np.minimum(np.maximum(num_tokens - 1, 0) // bin_size, nbins - 1)
 
 
 def make_schema(masking=False, binned=False):
@@ -62,9 +65,12 @@ def rows_to_table(rows, schema):
     return pa.table(columns, schema=schema)
 
 
-def write_shard(rows, out_dir, part_id, masking=False, bin_size=None,
-                target_seq_length=128, compression="snappy"):
-    """Write one block's rows as part.<part_id>.parquet[_<bin>] files.
+def write_shard_columns(columns, n, out_dir, part_id, masking=False,
+                        bin_size=None, target_seq_length=128,
+                        compression="snappy"):
+    """Write one block's COLUMNS ({name: list-or-ndarray}) as
+    part.<part_id>.parquet[_<bin>] files — the columnar fast path (no
+    per-row dicts anywhere between sample construction and arrow).
 
     Returns {written_path: num_rows}. With binning enabled, only non-empty
     bins produce a file (ref: binning.py:353-431); the balancer later
@@ -75,22 +81,49 @@ def write_shard(rows, out_dir, part_id, masking=False, bin_size=None,
     if bin_size is None:
         schema = make_schema(masking=masking, binned=False)
         path = os.path.join(out_dir, "part.{}.parquet".format(part_id))
-        pq.write_table(rows_to_table(rows, schema), path,
-                       compression=compression)
-        written[path] = len(rows)
+        pq.write_table(
+            pa.table({name: columns.get(name, []) for name in schema.names},
+                     schema=schema),
+            path, compression=compression)
+        written[path] = n
         return written
+
+    if n == 0:  # binned: empty buckets produce no files (like the old
+        return written  # row path and ref binning.py:353-431)
 
     nbins = num_bins(target_seq_length, bin_size)
     schema = make_schema(masking=masking, binned=True)
-    by_bin = {}
-    for r in rows:
-        b = bin_id_of_num_tokens(r["num_tokens"], bin_size, nbins)
-        r = dict(r)
-        r["bin_id"] = b
-        by_bin.setdefault(b, []).append(r)
-    for b, bin_rows in sorted(by_bin.items()):
-        path = os.path.join(out_dir, "part.{}.parquet_{}".format(part_id, b))
-        pq.write_table(rows_to_table(bin_rows, schema), path,
+    num_tokens = np.asarray(columns["num_tokens"], dtype=np.int64)
+    bins = bin_id_of_num_tokens(num_tokens, bin_size, nbins)
+    for b in np.unique(bins):
+        idx = np.nonzero(bins == b)[0]
+        sub = {}
+        for name in schema.names:
+            if name == "bin_id":
+                sub[name] = np.full(len(idx), b, dtype=np.int64)
+                continue
+            col = columns[name]
+            if isinstance(col, pa.Array):
+                sub[name] = col.take(idx)
+            elif isinstance(col, np.ndarray):
+                sub[name] = col[idx]
+            else:
+                sub[name] = [col[i] for i in idx.tolist()]
+        path = os.path.join(out_dir,
+                            "part.{}.parquet_{}".format(part_id, int(b)))
+        pq.write_table(pa.table(sub, schema=schema), path,
                        compression=compression)
-        written[path] = len(bin_rows)
+        written[path] = len(idx)
     return written
+
+
+def write_shard(rows, out_dir, part_id, masking=False, bin_size=None,
+                target_seq_length=128, compression="snappy"):
+    """Row-dict variant of write_shard_columns (kept for callers holding
+    rows; the pipeline hot path is columnar)."""
+    names = list(make_schema(masking=masking, binned=False).names)
+    columns = {name: [r.get(name) for r in rows] for name in names}
+    return write_shard_columns(columns, len(rows), out_dir, part_id,
+                               masking=masking, bin_size=bin_size,
+                               target_seq_length=target_seq_length,
+                               compression=compression)

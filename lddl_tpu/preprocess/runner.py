@@ -36,6 +36,7 @@ from .bert import (
     BertPretrainConfig,
     TokenizerInfo,
     instances_from_texts,
+    materialize_columns,
     materialize_rows,
 )
 from .readers import discover_source_files, plan_blocks, read_documents
@@ -139,14 +140,16 @@ class BertBucketProcessor:
         lrng.shuffle(g, texts)
         batch = instances_from_texts(texts, self.tok_info, config, seed,
                                      bucket)
-        rows = materialize_rows(batch, config, self.tok_info, seed,
-                                (0x3A5C, bucket))
         if self.output_format == "txt":
+            rows = materialize_rows(batch, config, self.tok_info, seed,
+                                    (0x3A5C, bucket))
             return _write_txt_shard(rows, self.out_dir, bucket,
                                     config.masking, self.bin_size,
                                     config.max_seq_length)
-        return binning_mod.write_shard(
-            rows, self.out_dir, bucket, masking=config.masking,
+        columns, n = materialize_columns(batch, config, self.tok_info, seed,
+                                         (0x3A5C, bucket))
+        return binning_mod.write_shard_columns(
+            columns, n, self.out_dir, bucket, masking=config.masking,
             bin_size=self.bin_size,
             target_seq_length=config.max_seq_length)
 

@@ -304,3 +304,18 @@ def test_txt_output(tiny_corpus, tokenizer, tmp_path):
     line = open(list(written)[0]).readline()
     assert line.startswith("is_random_next: ")
     assert "[CLS]" in line and "[SEP]" in line
+
+
+def test_write_shard_columns_empty_bucket(tmp_path):
+    """Empty buckets: unbinned writes an empty shard (schema intact),
+    binned writes nothing — matching the row path and the reference."""
+    from lddl_tpu.preprocess.binning import write_shard_columns
+    import pyarrow.parquet as pq
+    out = str(tmp_path)
+    written = write_shard_columns({}, 0, out, 7, masking=True, bin_size=None)
+    [(path, n)] = written.items()
+    assert n == 0
+    t = pq.read_table(path)
+    assert t.num_rows == 0
+    assert set(t.schema.names) >= {"A", "B", "masked_lm_positions"}
+    assert write_shard_columns({}, 0, out, 8, masking=True, bin_size=32) == {}
