@@ -73,7 +73,7 @@ def _load():
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.
         try:
-            if lib.lddl_native_abi_version() != 3:
+            if lib.lddl_native_abi_version() != 4:
                 return None
         except AttributeError:
             return None
@@ -83,6 +83,13 @@ def _load():
         lib.lddl_tok_free.argtypes = [ctypes.c_void_p]
         lib.lddl_tok_set_memo_cap.argtypes = [ctypes.c_void_p,
                                               ctypes.c_int64]
+        lib.lddl_join_tokens.restype = None
+        lib.lddl_join_tokens.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32)]
         lib.lddl_tok_docs.restype = ctypes.POINTER(_TokResult)
         lib.lddl_tok_docs.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p,
@@ -105,6 +112,34 @@ def _load():
 
 def available():
     return _load() is not None
+
+
+def join_tokens(flat_ids, row_lens, blob, tok_starts, tok_lens,
+                total_bytes):
+    """Space-join token ids into one contiguous UTF-8 buffer + int32 value
+    offsets (the Arrow StringArray layout) with the C memcpy kernel.
+    Returns (data uint8[total_bytes], offsets int32[n_rows+1]) or None
+    when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    flat_ids = np.ascontiguousarray(flat_ids, dtype=np.int32)
+    row_lens = np.ascontiguousarray(row_lens, dtype=np.int64)
+    tok_starts = np.ascontiguousarray(tok_starts, dtype=np.int64)
+    tok_lens = np.ascontiguousarray(tok_lens, dtype=np.int64)
+    out = np.empty(int(total_bytes), dtype=np.uint8)
+    offsets = np.empty(len(row_lens) + 1, dtype=np.int32)
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    lib.lddl_join_tokens(
+        flat_ids.ctypes.data_as(p_i32), len(flat_ids),
+        row_lens.ctypes.data_as(p_i64), len(row_lens),
+        blob,
+        tok_starts.ctypes.data_as(p_i64),
+        tok_lens.ctypes.data_as(p_i64),
+        out.ctypes.data_as(ctypes.c_char_p),
+        offsets.ctypes.data_as(p_i32))
+    return out, offsets
 
 
 def _pack_docs(texts):

@@ -32,20 +32,35 @@ def _offsets32(row_bytes):
     return offsets.astype(np.int32)
 
 
-def joined_token_strings(flat_ids, row_lens, spaced_table, tok_lens):
+def joined_token_strings(flat_ids, row_lens, table):
     """StringArray: row i = space-joined tokens of its slice of
     ``flat_ids`` (row-major, ``row_lens[i]`` ids per row).
 
-    ``spaced_table``/``tok_lens``: per-id UTF-8 bytes, plain at 2*id and
-    space-prefixed at 2*id+1, plus per-id byte lengths
-    (TokenizerInfo.token_byte_table). The data buffer is ONE C-level
-    ``b"".join`` (memcpy per token); offsets come from a vectorized
-    cumsum — no per-row Python strings.
+    ``table`` is TokenizerInfo.token_byte_table(). Fast path: the native
+    C memcpy join fills the Arrow data+offsets buffers in one pass.
+    Fallback: ONE C-level ``b"".join`` over the per-id plain/space-
+    prefixed bytes table. Either way no per-row Python string exists.
     """
     flat_ids = np.asarray(flat_ids, dtype=np.int64)
     row_lens = np.asarray(row_lens, dtype=np.int64)
     n = len(row_lens)
-    tl = tok_lens[flat_ids]
+    tl = table.lens[flat_ids]
+    n_nonempty = int(np.count_nonzero(row_lens))
+    total = int(tl.sum()) + len(flat_ids) - n_nonempty
+    if total >= 1 << 31:
+        raise ValueError(
+            "column exceeds 2GiB in one bucket; raise --num-blocks so "
+            "buckets shrink")
+
+    from .. import native
+    joined = native.join_tokens(flat_ids, row_lens, table.blob,
+                                table.starts, table.lens, total)
+    if joined is not None:
+        data, offsets = joined
+        return pa.Array.from_buffers(
+            pa.utf8(), n, [None, pa.py_buffer(offsets),
+                           pa.py_buffer(data)])
+
     # A leading space before every token except each row's first.
     first = np.zeros(len(flat_ids), dtype=bool)
     row_tok_starts = np.cumsum(row_lens) - row_lens
@@ -59,7 +74,7 @@ def joined_token_strings(flat_ids, row_lens, spaced_table, tok_lens):
     offsets = _offsets32(row_bytes)
 
     sel = ((flat_ids << 1) | has_space).tolist()
-    data = b"".join(map(spaced_table.__getitem__, sel))
+    data = b"".join(map(table.spaced.__getitem__, sel))
     return pa.Array.from_buffers(
         pa.utf8(), n, [None, pa.py_buffer(offsets), pa.py_buffer(data)])
 

@@ -74,6 +74,28 @@ class BertPretrainConfig:
                 np.ceil(self.masked_lm_ratio * self.max_seq_length))
 
 
+class _TokenByteTable:
+    """Vocab byte tables; ``spaced`` (the Python fallback's 2x-vocab join
+    table) is built lazily — unreachable when the native join is up."""
+
+    def __init__(self, enc, starts, lens):
+        self._enc = enc
+        self.blob = b"".join(enc)
+        self.starts = starts
+        self.lens = lens
+        self._spaced = None
+
+    @property
+    def spaced(self):
+        if self._spaced is None:
+            spaced = []
+            for b in self._enc:
+                spaced.append(b)
+                spaced.append(b" " + b)
+            self._spaced = spaced
+        return self._spaced
+
+
 class TokenizerInfo:
     """Pre-extracted tokenizer tables the id-based pipeline needs."""
 
@@ -129,17 +151,18 @@ class TokenizerInfo:
         return " ".join(self.id_to_token[np.asarray(ids, dtype=np.int64)])
 
     def token_byte_table(self):
-        """(spaced_table, lens): per-id UTF-8 bytes — plain at 2*id,
-        space-prefixed at 2*id+1 — plus per-id byte lengths, for the
-        vectorized Arrow column builders (preprocess.arrowcols)."""
+        """Vocab byte tables for the Arrow column builders
+        (preprocess.arrowcols): ``blob`` = all token UTF-8 bytes
+        concatenated with per-id ``starts``/``lens`` (the native join
+        kernel's gather tables), and ``spaced`` = per-id bytes, plain at
+        2*id / space-prefixed at 2*id+1 (the Python fallback's join
+        table)."""
         if self._token_bytes is None:
             enc = [t.encode("utf-8") for t in self.token_list]
             lens = np.fromiter(map(len, enc), dtype=np.int64, count=len(enc))
-            spaced = []
-            for b in enc:
-                spaced.append(b)
-                spaced.append(b" " + b)
-            self._token_bytes = (spaced, lens)
+            starts = np.zeros(len(enc), dtype=np.int64)
+            np.cumsum(lens[:-1], out=starts[1:])
+            self._token_bytes = _TokenByteTable(enc, starts, lens)
         return self._token_bytes
 
     def native_tokenizer(self):
@@ -598,7 +621,7 @@ def materialize_columns(batch, config, tok_info, seed, scope):
     n = len(batch)
     if n == 0:
         return {}, 0
-    spaced_table, tok_lens = tok_info.token_byte_table()
+    tok_table = tok_info.token_byte_table()
     a_lens = np.asarray(batch.a_lens, dtype=np.int64)
     seq_lens = np.asarray(batch.seq_lens, dtype=np.int64)
     b_lens = seq_lens - a_lens - 3
@@ -613,10 +636,8 @@ def materialize_columns(batch, config, tok_info, seed, scope):
         flat_b = batch.seq_ids[np.repeat(offsets + 2 + a_lens, b_lens)
                                + concat_aranges(b_lens)]
         return {
-            "A": joined_token_strings(flat_a, a_lens, spaced_table,
-                                      tok_lens),
-            "B": joined_token_strings(flat_b, b_lens, spaced_table,
-                                      tok_lens),
+            "A": joined_token_strings(flat_a, a_lens, tok_table),
+            "B": joined_token_strings(flat_b, b_lens, tok_table),
             "is_random_next": np.asarray(rn, dtype=bool),
             "num_tokens": seq_lens.astype(np.uint16),
         }, n
@@ -634,13 +655,13 @@ def materialize_columns(batch, config, tok_info, seed, scope):
     sel_rows, sel_cols = np.nonzero(selected)            # row-major: sorted
     sel_lens = np.bincount(sel_rows, minlength=n)
     return {
-        "A": joined_token_strings(flat_a, a_lens, spaced_table, tok_lens),
-        "B": joined_token_strings(flat_b, b_lens, spaced_table, tok_lens),
+        "A": joined_token_strings(flat_a, a_lens, tok_table),
+        "B": joined_token_strings(flat_b, b_lens, tok_table),
         "is_random_next": np.asarray(rn, dtype=bool),
         "num_tokens": seq_lens.astype(np.uint16),
         "masked_lm_positions": serialized_u16_binary(sel_cols, sel_lens),
         "masked_lm_labels": joined_token_strings(
-            ids[sel_rows, sel_cols], sel_lens, spaced_table, tok_lens),
+            ids[sel_rows, sel_cols], sel_lens, tok_table),
     }, n
 
 

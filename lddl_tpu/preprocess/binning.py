@@ -58,13 +58,6 @@ def make_schema(masking=False, binned=False):
     return pa.schema(list(fields.items()))
 
 
-def rows_to_table(rows, schema):
-    columns = {
-        name: [r.get(name) for r in rows] for name in schema.names
-    }
-    return pa.table(columns, schema=schema)
-
-
 def write_shard_columns(columns, n, out_dir, part_id, masking=False,
                         bin_size=None, target_seq_length=128,
                         compression="snappy"):
@@ -116,14 +109,3 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
         written[path] = len(idx)
     return written
 
-
-def write_shard(rows, out_dir, part_id, masking=False, bin_size=None,
-                target_seq_length=128, compression="snappy"):
-    """Row-dict variant of write_shard_columns (kept for callers holding
-    rows; the pipeline hot path is columnar)."""
-    names = list(make_schema(masking=masking, binned=False).names)
-    columns = {name: [r.get(name) for r in rows] for name in names}
-    return write_shard_columns(columns, len(rows), out_dir, part_id,
-                               masking=masking, bin_size=bin_size,
-                               target_seq_length=target_seq_length,
-                               compression=compression)

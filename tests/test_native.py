@@ -333,3 +333,30 @@ def test_fuzz_unicode_parity_vs_hf(hf_tokenizer):
             pos += n
             kept += 1
         assert int(doc_counts[d]) == kept
+
+
+def test_native_join_matches_python_fallback(hf_tokenizer):
+    """The C memcpy join and the Python b''.join fallback build identical
+    Arrow string columns."""
+    import numpy as np
+    from lddl_tpu import native as native_mod
+    from lddl_tpu.preprocess.arrowcols import joined_token_strings
+    info = TokenizerInfo(hf_tokenizer)
+    table = info.token_byte_table()
+    g = np.random.default_rng(5)
+    flat, lens = [], []
+    for _ in range(200):
+        m = int(g.integers(0, 12))
+        lens.append(m)
+        flat.extend(int(g.integers(0, info.vocab_size)) for _ in range(m))
+    flat = np.asarray(flat, dtype=np.int64)
+    lens = np.asarray(lens, dtype=np.int64)
+    a = joined_token_strings(flat, lens, table)
+    orig = native_mod.join_tokens
+    native_mod.join_tokens = lambda *args, **kw: None
+    try:
+        b = joined_token_strings(flat, lens, table)
+    finally:
+        native_mod.join_tokens = orig
+    assert a.equals(b)
+    assert a.to_pylist()[:3] == b.to_pylist()[:3]
