@@ -15,10 +15,9 @@ mkdir -p "$DATA"
 
 echo "== 1. corpus (synthetic; see download_wikipedia for the real one) =="
 python - "$DATA" <<'EOF'
-import sys, bench
-tmp, n = bench.make_corpus(target_mb=4, shards=4)
-import shutil, os
-shutil.move(os.path.join(tmp, "corpus"), os.path.join(sys.argv[1], "wiki"))
+import os, sys, bench
+n, _ = bench.make_corpus(os.path.join(sys.argv[1], "wiki"), target_mb=4,
+                         shards=4)
 print("corpus bytes:", n)
 EOF
 
