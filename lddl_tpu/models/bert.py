@@ -71,6 +71,17 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     dtype: Any = jnp.bfloat16  # activations; params stay fp32
+    # "dense": all-gather from sp into full-sequence attention
+    # (Megatron-SP). "ring": sequence-parallel exact attention — K/V
+    # blocks rotate over the sp ring (ops/ring_attention.py), no device
+    # ever holds the full sequence; attention-prob dropout is skipped
+    # under ring (standard for blockwise kernels). Falls back to dense
+    # when the mesh has no sp axis (or sp == 1).
+    attention_impl: str = "dense"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("dense", "ring"):
+            raise ValueError("attention_impl must be dense|ring")
 
     @staticmethod
     def bert_base(**kw):
@@ -149,28 +160,48 @@ class SelfAttention(nn.Module):
                     nn.initializers.zeros_init(), ("heads",)),
                 name=name)
 
-        def split_heads(t):
+        use_ring = False
+        if cfg.attention_impl == "ring":
+            from jax.sharding import get_abstract_mesh
+            mesh = get_abstract_mesh()
+            use_ring = ("sp" in mesh.axis_names
+                        and mesh.shape["sp"] > 1)
+
+        def split_heads(t, seq_ax):
             t = t.reshape(t.shape[0], t.shape[1], cfg.num_heads, head_dim)
-            return with_logical(t, ("batch", None, "heads", "kv"))
+            return with_logical(t, ("batch", seq_ax, "heads", "kv"))
 
-        # Attention computes over the full sequence: entering this block the
-        # activations all-gather from sp, and heads shard over tp.
-        q = split_heads(qkv_proj("query")(x))
-        k = split_heads(qkv_proj("key")(x))
-        v = split_heads(qkv_proj("value")(x))
+        if use_ring:
+            # Sequence stays sharded: Q/K/V keep the "seq" axis on sp and
+            # the ring rotates K/V blocks (ops/ring_attention.py).
+            from ..ops.ring_attention import ring_attention
 
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-            head_dim).astype(cfg.dtype)
-        # Finite large-negative (not dtype-min): fp32 min overflows to -inf
-        # in bf16, and an all-masked row would then softmax to NaN.
-        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
-                         -1e9).astype(cfg.dtype)
-        probs = nn.softmax(scores + bias, axis=-1)
-        probs = nn.Dropout(cfg.attention_dropout)(
-            probs, deterministic=deterministic)
-        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
-                          cfg.num_heads * head_dim)
+            q = split_heads(qkv_proj("query")(x), "seq")
+            k = split_heads(qkv_proj("key")(x), "seq")
+            v = split_heads(qkv_proj("value")(x), "seq")
+            ctx = ring_attention(q, k, v, attention_mask, mesh)
+            ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
+                              cfg.num_heads * head_dim)
+        else:
+            # Attention computes over the full sequence: entering this
+            # block the activations all-gather from sp, and heads shard
+            # over tp.
+            q = split_heads(qkv_proj("query")(x), None)
+            k = split_heads(qkv_proj("key")(x), None)
+            v = split_heads(qkv_proj("value")(x), None)
+
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                head_dim).astype(cfg.dtype)
+            # Finite large-negative (not dtype-min): fp32 min overflows to
+            # -inf in bf16, and an all-masked row would softmax to NaN.
+            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                             -1e9).astype(cfg.dtype)
+            probs = nn.softmax(scores + bias, axis=-1)
+            probs = nn.Dropout(cfg.attention_dropout)(
+                probs, deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
+                              cfg.num_heads * head_dim)
 
         # Row-parallel: input dim sharded over tp, XLA psums the output.
         out = nn.Dense(

@@ -7,6 +7,7 @@ from .masking import (
     make_jax_whole_word_masker,
 )
 from .packing import pad_to_bucket, round_up
+from .ring_attention import dense_attention_reference, ring_attention
 
 __all__ = [
     "plan_num_to_predict",
@@ -17,4 +18,6 @@ __all__ = [
     "make_jax_whole_word_masker",
     "pad_to_bucket",
     "round_up",
+    "ring_attention",
+    "dense_attention_reference",
 ]
