@@ -1,16 +1,22 @@
+from .bart import BartConfig, BartForPreTraining, bart_batch_loss
 from .bert import BertConfig, BertForPreTraining
 from .train import (
     TrainState,
     create_train_state,
+    make_eval_step,
     make_sharded_train_step,
     pretrain_loss,
 )
 
 __all__ = [
+    "BartConfig",
+    "BartForPreTraining",
+    "bart_batch_loss",
     "BertConfig",
     "BertForPreTraining",
     "TrainState",
     "create_train_state",
+    "make_eval_step",
     "make_sharded_train_step",
     "pretrain_loss",
 ]

@@ -1,0 +1,108 @@
+"""Shared multi-head attention for the model stack.
+
+One implementation serves BERT self-attention, BART encoder/decoder
+self-attention, and BART cross-attention — so the sharding annotations
+(Megatron column/row-parallel over tp), the finite -1e9 masking invariant
+(dtype-min overflows to -inf in bf16 and NaNs an all-masked row), and the
+ring-attention opt-in live in exactly one place.
+
+Ring attention (ops/ring_attention.py) engages when ``attention_impl ==
+"ring"``, the call is self-attention (q_input is kv_input), there is no
+extra additive bias (ring is bidirectional-full-attention only — causal
+decoding stays dense), and the ambient mesh has sp > 1.
+"""
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import flax.linen as nn
+
+with_logical = nn.with_logical_constraint
+
+
+class MultiHeadAttention(nn.Module):
+    """softmax(QK^T/sqrt(d) + bias) V with logical-axis sharding.
+
+    ``padding_mask``: [B, Lk] key validity (1 = attend), or None.
+    ``extra_bias``: optional additive [*, Lq, Lk] term (e.g. causal).
+    Child params are named query/key/value/output, so wrapping modules
+    keep stable checkpoint trees.
+    """
+
+    hidden_size: int
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+    initializer_range: float = 0.02
+    attention_impl: str = "dense"
+
+    @nn.compact
+    def __call__(self, q_input, kv_input, padding_mask, deterministic,
+                 extra_bias: Optional[Any] = None):
+        head_dim = self.hidden_size // self.num_heads
+        init = nn.initializers.normal(stddev=self.initializer_range)
+
+        def proj(name):
+            # Column-parallel: the flat (heads*head_dim) output dim shards
+            # over tp ("heads"); reshaped to [B, L, H, D] after.
+            return nn.Dense(
+                self.num_heads * head_dim, dtype=self.dtype,
+                kernel_init=nn.with_logical_partitioning(
+                    init, ("embed", "heads")),
+                bias_init=nn.with_logical_partitioning(
+                    nn.initializers.zeros_init(), ("heads",)),
+                name=name)
+
+        def split_heads(t, seq_ax):
+            t = t.reshape(t.shape[0], t.shape[1], self.num_heads, head_dim)
+            return with_logical(t, ("batch", seq_ax, "heads", "kv"))
+
+        use_ring = False
+        if (self.attention_impl == "ring" and q_input is kv_input
+                and extra_bias is None and padding_mask is not None):
+            from jax.sharding import get_abstract_mesh
+            mesh = get_abstract_mesh()
+            use_ring = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+
+        if use_ring:
+            # Sequence stays sharded: Q/K/V keep the "seq" axis on sp and
+            # K/V blocks rotate around the ring. Attention-prob dropout is
+            # skipped under ring (standard for blockwise kernels).
+            from ..ops.ring_attention import ring_attention
+
+            q = split_heads(proj("query")(q_input), "seq")
+            k = split_heads(proj("key")(kv_input), "seq")
+            v = split_heads(proj("value")(kv_input), "seq")
+            ctx = ring_attention(q, k, v, padding_mask, mesh)
+        else:
+            # Full-sequence attention: entering this block the activations
+            # all-gather from sp, and heads shard over tp.
+            q = split_heads(proj("query")(q_input), None)
+            k = split_heads(proj("key")(kv_input), None)
+            v = split_heads(proj("value")(kv_input), None)
+
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                head_dim).astype(self.dtype)
+            # Finite large-negative (not dtype-min): fp32 min overflows to
+            # -inf in bf16, and an all-masked row would softmax to NaN.
+            bias = 0.0
+            if padding_mask is not None:
+                bias = jnp.where(padding_mask[:, None, None, :] > 0, 0.0,
+                                 -1e9)
+            if extra_bias is not None:
+                bias = bias + extra_bias
+            probs = nn.softmax(scores + jnp.asarray(bias, self.dtype),
+                               axis=-1)
+            probs = nn.Dropout(self.dropout)(probs,
+                                             deterministic=deterministic)
+            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+        ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
+                          self.num_heads * head_dim)
+        # Row-parallel: input dim sharded over tp, XLA psums the output.
+        out = nn.Dense(
+            self.hidden_size, dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                init, ("heads", "embed")),
+            name="output")(ctx)
+        return with_logical(out, ("batch", "seq", "embed"))

@@ -141,75 +141,18 @@ class Embeddings(nn.Module):
         return x
 
 
-class SelfAttention(nn.Module):
-    cfg: BertConfig
-
-    @nn.compact
-    def __call__(self, x, attention_mask, deterministic):
-        cfg = self.cfg
-        head_dim = cfg.hidden_size // cfg.num_heads
-
-        def qkv_proj(name):
-            # Column-parallel: the flat (heads*head_dim) output dim shards
-            # over tp ("heads"); reshaped to [B, L, H, D] after.
-            return nn.Dense(
-                cfg.num_heads * head_dim, dtype=cfg.dtype,
-                kernel_init=nn.with_logical_partitioning(
-                    _dense_init(cfg), ("embed", "heads")),
-                bias_init=nn.with_logical_partitioning(
-                    nn.initializers.zeros_init(), ("heads",)),
-                name=name)
-
-        use_ring = False
-        if cfg.attention_impl == "ring":
-            from jax.sharding import get_abstract_mesh
-            mesh = get_abstract_mesh()
-            use_ring = ("sp" in mesh.axis_names
-                        and mesh.shape["sp"] > 1)
-
-        def split_heads(t, seq_ax):
-            t = t.reshape(t.shape[0], t.shape[1], cfg.num_heads, head_dim)
-            return with_logical(t, ("batch", seq_ax, "heads", "kv"))
-
-        if use_ring:
-            # Sequence stays sharded: Q/K/V keep the "seq" axis on sp and
-            # the ring rotates K/V blocks (ops/ring_attention.py).
-            from ..ops.ring_attention import ring_attention
-
-            q = split_heads(qkv_proj("query")(x), "seq")
-            k = split_heads(qkv_proj("key")(x), "seq")
-            v = split_heads(qkv_proj("value")(x), "seq")
-            ctx = ring_attention(q, k, v, attention_mask, mesh)
-            ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
-                              cfg.num_heads * head_dim)
-        else:
-            # Attention computes over the full sequence: entering this
-            # block the activations all-gather from sp, and heads shard
-            # over tp.
-            q = split_heads(qkv_proj("query")(x), None)
-            k = split_heads(qkv_proj("key")(x), None)
-            v = split_heads(qkv_proj("value")(x), None)
-
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-                head_dim).astype(cfg.dtype)
-            # Finite large-negative (not dtype-min): fp32 min overflows to
-            # -inf in bf16, and an all-masked row would softmax to NaN.
-            bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
-                             -1e9).astype(cfg.dtype)
-            probs = nn.softmax(scores + bias, axis=-1)
-            probs = nn.Dropout(cfg.attention_dropout)(
-                probs, deterministic=deterministic)
-            ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
-            ctx = ctx.reshape(ctx.shape[0], ctx.shape[1],
-                              cfg.num_heads * head_dim)
-
-        # Row-parallel: input dim sharded over tp, XLA psums the output.
-        out = nn.Dense(
-            cfg.hidden_size, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("heads", "embed")),
-            name="output")(ctx)
-        return with_logical(out, ("batch", "seq", "embed"))
+def _attention(cfg, name):
+    """The shared MultiHeadAttention configured from a model config; child
+    params named query/key/value/output (stable checkpoint trees)."""
+    from .attention import MultiHeadAttention
+    return MultiHeadAttention(
+        hidden_size=cfg.hidden_size,
+        num_heads=cfg.num_heads,
+        dtype=cfg.dtype,
+        dropout=cfg.attention_dropout,
+        initializer_range=cfg.initializer_range,
+        attention_impl=cfg.attention_impl,
+        name=name)
 
 
 class EncoderLayer(nn.Module):
@@ -218,8 +161,8 @@ class EncoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, attention_mask, deterministic):
         cfg = self.cfg
-        attn = SelfAttention(cfg, name="attention")(
-            x, attention_mask, deterministic)
+        attn = _attention(cfg, "attention")(x, x, attention_mask,
+                                            deterministic)
         attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="attention_norm")(x + attn)

@@ -8,6 +8,8 @@ the batch sharded over the data axes. All collectives are XLA-inserted
 all-gather around the sequence-sharded regions).
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import optax
@@ -70,14 +72,17 @@ def make_optimizer(learning_rate=1e-4, weight_decay=0.01, warmup_steps=100,
     )
 
 
+def _batch_inputs(model, batch):
+    """Positional model inputs drawn from the batch dict. Models declare
+    their consumed keys via BATCH_INPUTS (BERT's triple by default)."""
+    keys = getattr(model, "BATCH_INPUTS",
+                   ("input_ids", "token_type_ids", "attention_mask"))
+    return tuple(batch[k] for k in keys)
+
+
 def _init_variables(model, rng, sample_batch):
-    return model.init(
-        {"params": rng},
-        sample_batch["input_ids"],
-        sample_batch["token_type_ids"],
-        sample_batch["attention_mask"],
-        deterministic=True,
-    )
+    return model.init({"params": rng}, *_batch_inputs(model, sample_batch),
+                      deterministic=True)
 
 
 def param_shardings_of(mesh, model, sample_batch, abstract_variables=None):
@@ -163,31 +168,37 @@ def create_train_state(config, mesh, sample_batch, seed=0, optimizer=None,
     return state, shardings
 
 
+def bert_batch_loss(outputs, batch, ignore_index=-1):
+    """Default loss adapter: BertForPreTraining outputs -> pretrain_loss."""
+    mlm_logits, nsp_logits = outputs
+    return pretrain_loss(mlm_logits, nsp_logits, batch["labels"],
+                         batch["next_sentence_labels"],
+                         ignore_index=ignore_index)
+
+
 def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
-                            donate=True):
+                            donate=True, batch_loss=None):
     """A jitted SPMD train step: (state, batch, seed) -> (state, metrics).
 
     Batch arrays must be globally-sharded jax.Arrays over the mesh's data
     axes (use lddl_tpu.loader.to_device_batch). Dropout randomness is
-    deterministic per (seed, step).
-    """
+    deterministic per (seed, step). ``batch_loss(outputs, batch)`` ->
+    (loss, metrics) adapts non-BERT models (e.g. models.bart)."""
     model = model or BertForPreTraining(config)
+    batch_loss = batch_loss or functools.partial(bert_batch_loss,
+                                                 ignore_index=ignore_index)
 
     def step_fn(state, batch, seed):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
 
         def loss_fn(params):
-            mlm_logits, nsp_logits = model.apply(
+            outputs = model.apply(
                 {"params": params},
-                batch["input_ids"],
-                batch["token_type_ids"],
-                batch["attention_mask"],
+                *_batch_inputs(model, batch),
                 deterministic=False,
                 rngs={"dropout": dropout_rng},
             )
-            return pretrain_loss(mlm_logits, nsp_logits, batch["labels"],
-                                 batch["next_sentence_labels"],
-                                 ignore_index=ignore_index)
+            return batch_loss(outputs, batch)
 
         (_, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
@@ -206,21 +217,20 @@ def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
     return wrapped
 
 
-def make_eval_step(mesh, config, model=None, ignore_index=-1):
+def make_eval_step(mesh, config, model=None, ignore_index=-1,
+                   batch_loss=None):
     """Jitted forward-only step returning metrics."""
     model = model or BertForPreTraining(config)
+    batch_loss = batch_loss or functools.partial(bert_batch_loss,
+                                                 ignore_index=ignore_index)
 
     def step_fn(params, batch):
-        mlm_logits, nsp_logits = model.apply(
+        outputs = model.apply(
             {"params": params},
-            batch["input_ids"],
-            batch["token_type_ids"],
-            batch["attention_mask"],
+            *_batch_inputs(model, batch),
             deterministic=True,
         )
-        _, metrics = pretrain_loss(mlm_logits, nsp_logits, batch["labels"],
-                                   batch["next_sentence_labels"],
-                                   ignore_index=ignore_index)
+        _, metrics = batch_loss(outputs, batch)
         return metrics
 
     jitted = jax.jit(step_fn)

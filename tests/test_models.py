@@ -110,3 +110,136 @@ def test_attention_mask_blocks_padding(tiny_cfg):
     mlm2, _ = model.apply({"params": params}, ids2, b["token_type_ids"], mask)
     np.testing.assert_allclose(np.asarray(mlm1[:, :12]),
                                np.asarray(mlm2[:, :12]), atol=2e-2)
+
+
+def _fake_bart_batch(cfg, B=4, L=24, seed=0):
+    g = np.random.default_rng(seed)
+    input_ids = g.integers(5, cfg.vocab_size, (B, L)).astype(np.int32)
+    attention_mask = np.ones((B, L), np.int32)
+    attention_mask[0, L - 5:] = 0
+    input_ids[0, L - 5:] = 0
+    decoder_input_ids = g.integers(5, cfg.vocab_size, (B, L)).astype(np.int32)
+    labels = np.roll(decoder_input_ids, -1, axis=1).astype(np.int32)
+    labels[:, -1] = -1
+    if B > 1:
+        labels[1, 10:] = -1  # padded targets ignored
+    return {
+        "input_ids": input_ids,
+        "attention_mask": attention_mask,
+        "decoder_input_ids": decoder_input_ids,
+        "labels": labels,
+    }
+
+
+def test_bart_forward_shapes():
+    import flax.linen as nn
+    from lddl_tpu.models import BartConfig, BartForPreTraining
+    cfg = BartConfig.tiny()
+    model = BartForPreTraining(cfg)
+    b = _fake_bart_batch(cfg, B=2, L=16)
+    variables = model.init(jax.random.PRNGKey(0), b["input_ids"],
+                           b["attention_mask"], b["decoder_input_ids"])
+    logits = model.apply({"params": nn.meta.unbox(variables)["params"]},
+                         b["input_ids"], b["attention_mask"],
+                         b["decoder_input_ids"])
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_bart_decoder_is_causal():
+    """Changing a future decoder token must not change earlier logits."""
+    import flax.linen as nn
+    from lddl_tpu.models import BartConfig, BartForPreTraining
+    import jax.numpy as jnp
+    cfg = BartConfig.tiny(hidden_dropout=0.0, attention_dropout=0.0,
+                          dtype=jnp.float32)
+    model = BartForPreTraining(cfg)
+    b = _fake_bart_batch(cfg, B=1, L=12)
+    params = nn.meta.unbox(model.init(
+        jax.random.PRNGKey(0), b["input_ids"], b["attention_mask"],
+        b["decoder_input_ids"]))["params"]
+
+    def logits_of(dec):
+        return np.asarray(model.apply(
+            {"params": params}, b["input_ids"], b["attention_mask"], dec,
+            deterministic=True))
+
+    base = logits_of(b["decoder_input_ids"])
+    mutated = b["decoder_input_ids"].copy()
+    mutated[0, 8] = (mutated[0, 8] + 1) % cfg.vocab_size
+    changed = logits_of(mutated)
+    np.testing.assert_allclose(base[0, :8], changed[0, :8],
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(base[0, 8:], changed[0, 8:])
+
+
+def test_bart_train_step_learns():
+    from lddl_tpu.models import (BartConfig, BartForPreTraining,
+                                 bart_batch_loss, create_train_state,
+                                 make_sharded_train_step)
+    cfg = BartConfig.tiny()
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    model = BartForPreTraining(cfg)
+    batch_np = _fake_bart_batch(cfg, B=4, L=32)
+    state, _ = create_train_state(
+        cfg, mesh, batch_np, model=model,
+        optimizer=make_optimizer(learning_rate=5e-3, warmup_steps=1,
+                                 total_steps=30))
+    step = make_sharded_train_step(mesh, cfg, model=model,
+                                   batch_loss=bart_batch_loss)
+    batch = to_device_batch(batch_np, mesh)
+    losses = []
+    for i in range(8):
+        state, metrics = step(state, batch, seed=0)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]  # memorizes the fixed batch
+
+
+def test_bart_loader_to_model_e2e(tmp_path):
+    """Full BART path: preprocess chunks -> balance -> loader -> one
+    sharded train step (the consumer the reference never had)."""
+    import flax.linen as nn
+    from lddl_tpu.preprocess import (build_wordpiece_vocab, get_tokenizer,
+                                     run_bart_preprocess)
+    from lddl_tpu.balance import balance_shards
+    from lddl_tpu.loader.bart import get_bart_pretrain_data_loader
+    from lddl_tpu.models import (BartConfig, BartForPreTraining,
+                                 bart_batch_loss, create_train_state,
+                                 make_sharded_train_step)
+
+    source = tmp_path / "corpus" / "source"
+    source.mkdir(parents=True)
+    words = "alpha beta gamma delta epsilon zeta eta theta".split()
+    g = np.random.default_rng(0)
+    with open(source / "0.txt", "w") as f:
+        for d in range(30):
+            sents = [" ".join(g.choice(words, 8)).capitalize() + "."
+                     for _ in range(4)]
+            f.write("doc-{} {}\n".format(d, " ".join(sents)))
+    vocab = build_wordpiece_vocab([" ".join(words)] * 3,
+                                  str(tmp_path / "v.txt"), vocab_size=120)
+    tok = get_tokenizer(vocab_file=vocab)
+    from lddl_tpu.preprocess.bart import BartPretrainConfig
+    run_bart_preprocess({"w": str(tmp_path / "corpus")},
+                        str(tmp_path / "pre"),
+                        config=BartPretrainConfig(target_seq_length=48),
+                        num_blocks=2, sample_ratio=1.0, seed=0)
+    balance_shards(str(tmp_path / "pre"), str(tmp_path / "bal"), 2)
+    loader = get_bart_pretrain_data_loader(
+        str(tmp_path / "bal"), tokenizer=tok, batch_size=8,
+        max_seq_length=64, fixed_seq_length=64, base_seed=3)
+    batch_np = next(iter(loader))
+    assert batch_np["input_ids"].shape[1] == 64
+
+    # Pad model vocab up to a tp-divisible size (extra ids unused).
+    cfg = BartConfig.tiny(vocab_size=((len(tok) + 7) // 8) * 8)
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    model = BartForPreTraining(cfg)
+    state, _ = create_train_state(cfg, mesh, batch_np, model=model,
+                                  optimizer=make_optimizer(warmup_steps=1,
+                                                           total_steps=5))
+    step = make_sharded_train_step(mesh, cfg, model=model,
+                                   batch_loss=bart_batch_loss)
+    state, metrics = step(state, to_device_batch(batch_np, mesh), seed=0)
+    assert np.isfinite(float(metrics["loss"]))
