@@ -1,5 +1,6 @@
 from .bart import BartConfig, BartForPreTraining, bart_batch_loss
 from .bert import BertConfig, BertForPreTraining
+from .checkpoint import latest_step, restore_train_state, save_train_state
 from .train import (
     TrainState,
     create_train_state,
@@ -14,6 +15,9 @@ __all__ = [
     "bart_batch_loss",
     "BertConfig",
     "BertForPreTraining",
+    "latest_step",
+    "restore_train_state",
+    "save_train_state",
     "TrainState",
     "create_train_state",
     "make_eval_step",

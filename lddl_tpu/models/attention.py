@@ -106,3 +106,31 @@ class MultiHeadAttention(nn.Module):
                 init, ("heads", "embed")),
             name="output")(ctx)
         return with_logical(out, ("batch", "seq", "embed"))
+
+
+class FeedForward(nn.Module):
+    """Column-parallel expand (gelu) + row-parallel contract — the one
+    transformer MLP both model families use (children:
+    intermediate/output)."""
+
+    hidden_size: int
+    intermediate_size: int
+    dtype: Any = jnp.bfloat16
+    initializer_range: float = 0.02
+
+    @nn.compact
+    def __call__(self, x):
+        init = nn.initializers.normal(stddev=self.initializer_range)
+        h = nn.Dense(
+            self.intermediate_size, dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                init, ("embed", "mlp")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("mlp",)),
+            name="intermediate")(x)
+        h = nn.gelu(h, approximate=True)
+        return nn.Dense(
+            self.hidden_size, dtype=self.dtype,
+            kernel_init=nn.with_logical_partitioning(
+                init, ("mlp", "embed")),
+            name="output")(h)

@@ -24,6 +24,7 @@ import flax.linen as nn
 from .bert import (  # noqa: F401 (shared rules)
     LOGICAL_AXIS_RULES,
     _attention,
+    _feed_forward,
     axis_rules_for,
     with_logical,
 )
@@ -73,28 +74,6 @@ def _dense_init(cfg):
     return nn.initializers.normal(stddev=cfg.initializer_range)
 
 
-class FeedForward(nn.Module):
-    cfg: BartConfig
-
-    @nn.compact
-    def __call__(self, x, deterministic):
-        cfg = self.cfg
-        h = nn.Dense(
-            cfg.intermediate_size, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("embed", "mlp")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("mlp",)),
-            name="intermediate")(x)
-        h = nn.gelu(h, approximate=True)
-        h = nn.Dense(
-            cfg.hidden_size, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("mlp", "embed")),
-            name="output")(h)
-        return nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
-
-
 class Embeddings(nn.Module):
     """Shared token embedding + learned positions (one instance each for
     encoder and decoder inputs; the token table is shared via the parent
@@ -135,7 +114,8 @@ class EncoderLayer(nn.Module):
         a = nn.Dropout(cfg.hidden_dropout)(a, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="self_norm")(x + a)
-        h = FeedForward(cfg, name="ffn")(x, deterministic)
+        h = _feed_forward(cfg)(x)
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ffn_norm")(x + h)
         return with_logical(x, ("batch", "seq", "embed"))
@@ -159,7 +139,8 @@ class DecoderLayer(nn.Module):
         c = nn.Dropout(cfg.hidden_dropout)(c, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="cross_norm")(x + c)
-        h = FeedForward(cfg, name="ffn")(x, deterministic)
+        h = _feed_forward(cfg)(x)
+        h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ffn_norm")(x + h)
         return with_logical(x, ("batch", "seq", "embed"))
@@ -203,6 +184,8 @@ class BartForPreTraining(nn.Module):
             cfg.vocab_size, dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 _dense_init(cfg), ("embed", "vocab")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)),
             name="lm_head")(y)
         return logits
 

@@ -155,6 +155,17 @@ def _attention(cfg, name):
         name=name)
 
 
+def _feed_forward(cfg, name="ffn"):
+    """The shared transformer MLP configured from a model config."""
+    from .attention import FeedForward
+    return FeedForward(
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        dtype=cfg.dtype,
+        initializer_range=cfg.initializer_range,
+        name=name)
+
+
 class EncoderLayer(nn.Module):
     cfg: BertConfig
 
@@ -167,19 +178,7 @@ class EncoderLayer(nn.Module):
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="attention_norm")(x + attn)
 
-        h = nn.Dense(
-            cfg.intermediate_size, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("embed", "mlp")),
-            bias_init=nn.with_logical_partitioning(
-                nn.initializers.zeros_init(), ("mlp",)),
-            name="intermediate")(x)
-        h = nn.gelu(h, approximate=True)
-        h = nn.Dense(
-            cfg.hidden_size, dtype=cfg.dtype,
-            kernel_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("mlp", "embed")),
-            name="ffn_output")(h)
+        h = _feed_forward(cfg)(x)
         h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ffn_norm")(x + h)
@@ -216,6 +215,8 @@ class BertForPreTraining(nn.Module):
             cfg.vocab_size, dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 _dense_init(cfg), ("embed", "vocab")),
+            bias_init=nn.with_logical_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)),
             name="mlm_decoder")(h)
 
         # NSP head over the [CLS] position.

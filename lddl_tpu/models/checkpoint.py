@@ -1,0 +1,96 @@
+"""Sharded checkpoint save/restore for TrainState (orbax).
+
+The reference has NO model state files — its resume story is loader-side
+recomputation by seeding (SURVEY.md §5: ``start_epoch``). lddl_tpu keeps
+that loader contract and adds the other half a real training job needs:
+the model/optimizer state, saved and restored AS SHARDS on an arbitrary
+mesh (no host ever gathers the full state), via orbax.
+
+The two halves compose into exact resume:
+
+    state = restore_train_state(ckpt_dir, state_template, shardings)
+    epoch = int(state.step) // steps_per_epoch
+    loader = get_bert_pretrain_data_loader(..., start_epoch=epoch)
+
+(orbax writes are atomic — a crash mid-save leaves the previous step
+intact; ``keep`` bounds disk use.)
+"""
+
+import jax
+import numpy as np
+
+
+import os
+
+
+def _manager(ckpt_dir, keep=3, create=False):
+    import orbax.checkpoint as ocp
+    options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=create)
+    return ocp.CheckpointManager(ckpt_dir, options=options)
+
+
+def save_train_state(ckpt_dir, state, keep=3):
+    """Save ``state`` (a models.train.TrainState) at its current step.
+
+    Writes shards from every process (call on ALL hosts of a multi-host
+    mesh); blocks until the write is durable. Returns the saved step."""
+    import orbax.checkpoint as ocp
+    step = int(jax.device_get(state.step))
+    mgr = _manager(ckpt_dir, keep=keep, create=True)
+    # tx is static (not a pytree leaf); persist only the array state.
+    payload = {"step": state.step, "params": state.params,
+               "opt_state": state.opt_state}
+    mgr.save(step, args=ocp.args.StandardSave(payload))
+    mgr.close()  # waits for the async write
+    return step
+
+
+def latest_step(ckpt_dir):
+    """Newest saved step under ``ckpt_dir``; None when the directory does
+    not exist or holds no checkpoints. Read-only: never creates
+    directories, and real I/O errors propagate."""
+    if not os.path.isdir(ckpt_dir):
+        return None
+    mgr = _manager(ckpt_dir)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
+def restore_train_state(ckpt_dir, state_template, shardings, step=None):
+    """Restore into the shapes/shardings of ``state_template`` (a
+    TrainState from create_train_state — same model, same mesh; the
+    restored arrays materialize directly as shards). Returns the restored
+    TrainState."""
+    import orbax.checkpoint as ocp
+    if step is None:
+        step = latest_step(ckpt_dir)
+    if step is None:
+        raise FileNotFoundError(
+            "no checkpoint under {}".format(ckpt_dir))
+    mgr = _manager(ckpt_dir)
+
+    target = {
+        "step": jax.ShapeDtypeStruct(state_template.step.shape,
+                                     state_template.step.dtype,
+                                     sharding=shardings.step),
+        "params": jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            state_template.params, shardings.params),
+        "opt_state": jax.tree.map(
+            lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+            state_template.opt_state, shardings.opt_state),
+    }
+    restored = mgr.restore(step, args=ocp.args.StandardRestore(target))
+    mgr.close()
+    # orbax may restore small leaves replicated; re-place everything onto
+    # the exact target shardings (no-op where already correct).
+    restored = {
+        "step": jax.device_put(restored["step"], shardings.step),
+        "params": jax.device_put(restored["params"], shardings.params),
+        "opt_state": jax.device_put(restored["opt_state"],
+                                    shardings.opt_state),
+    }
+    return state_template.replace(step=restored["step"],
+                                  params=restored["params"],
+                                  opt_state=restored["opt_state"])

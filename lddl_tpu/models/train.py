@@ -183,8 +183,14 @@ def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
     Batch arrays must be globally-sharded jax.Arrays over the mesh's data
     axes (use lddl_tpu.loader.to_device_batch). Dropout randomness is
     deterministic per (seed, step). ``batch_loss(outputs, batch)`` ->
-    (loss, metrics) adapts non-BERT models (e.g. models.bart)."""
+    (loss, metrics) adapts non-BERT models (e.g. models.bart; bind its
+    ignore_index yourself, e.g. functools.partial(bart_batch_loss,
+    ignore_index=...))."""
     model = model or BertForPreTraining(config)
+    if batch_loss is not None and ignore_index != -1:
+        raise ValueError(
+            "ignore_index only configures the default BERT loss; bind it "
+            "into your batch_loss instead")
     batch_loss = batch_loss or functools.partial(bert_batch_loss,
                                                  ignore_index=ignore_index)
 
@@ -221,6 +227,10 @@ def make_eval_step(mesh, config, model=None, ignore_index=-1,
                    batch_loss=None):
     """Jitted forward-only step returning metrics."""
     model = model or BertForPreTraining(config)
+    if batch_loss is not None and ignore_index != -1:
+        raise ValueError(
+            "ignore_index only configures the default BERT loss; bind it "
+            "into your batch_loss instead")
     batch_loss = batch_loss or functools.partial(bert_batch_loss,
                                                  ignore_index=ignore_index)
 

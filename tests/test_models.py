@@ -49,16 +49,16 @@ def test_param_shardings_on_mesh(tiny_cfg):
     p = state.params
     # Column-parallel QKV/MLP shard their output dim over tp.
     assert p["layer_0"]["attention"]["query"]["kernel"].sharding.spec[-1] == "tp"
-    assert p["layer_0"]["intermediate"]["kernel"].sharding.spec[-1] == "tp"
+    assert p["layer_0"]["ffn"]["intermediate"]["kernel"].sharding.spec[-1] == "tp"
     # Row-parallel outputs shard their input dim.
     assert p["layer_0"]["attention"]["output"]["kernel"].sharding.spec[0] == "tp"
-    assert p["layer_0"]["ffn_output"]["kernel"].sharding.spec[0] == "tp"
+    assert p["layer_0"]["ffn"]["output"]["kernel"].sharding.spec[0] == "tp"
     # Vocab-sharded embedding + decoder.
     assert p["embeddings"]["word_embeddings"]["embedding"].sharding.spec[0] == "tp"
     assert p["mlm_decoder"]["kernel"].sharding.spec[-1] == "tp"
     # Adam mu mirrors param shardings.
     mu = state.opt_state[1][0].mu
-    assert mu["layer_0"]["intermediate"]["kernel"].sharding.spec[-1] == "tp"
+    assert mu["layer_0"]["ffn"]["intermediate"]["kernel"].sharding.spec[-1] == "tp"
 
 
 def test_train_step_learns(tiny_cfg):
