@@ -105,7 +105,7 @@ class MultiHeadAttention(nn.Module):
             kernel_init=nn.with_logical_partitioning(
                 init, ("heads", "embed")),
             name="output")(ctx)
-        return with_logical(out, ("batch", "seq", "embed"))
+        return with_logical(out, ("batch", "seq", "act_embed"))
 
 
 class FeedForward(nn.Module):

@@ -90,7 +90,7 @@ class Embeddings(nn.Module):
             embedding_init=nn.with_logical_partitioning(
                 _dense_init(cfg), (None, "embed")),
             name="positions")(jnp.arange(input_ids.shape[1])[None, :])
-        x = with_logical(x + pos, ("batch", "seq", "embed"))
+        x = with_logical(x + pos, ("batch", "seq", "act_embed"))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="layer_norm")(x)
         return nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
@@ -118,7 +118,7 @@ class EncoderLayer(nn.Module):
         h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ffn_norm")(x + h)
-        return with_logical(x, ("batch", "seq", "embed"))
+        return with_logical(x, ("batch", "seq", "act_embed"))
 
 
 class DecoderLayer(nn.Module):
@@ -143,7 +143,7 @@ class DecoderLayer(nn.Module):
         h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ffn_norm")(x + h)
-        return with_logical(x, ("batch", "seq", "embed"))
+        return with_logical(x, ("batch", "seq", "act_embed"))
 
 
 class BartForPreTraining(nn.Module):

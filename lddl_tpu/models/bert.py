@@ -27,10 +27,17 @@ import jax.numpy as jnp
 import flax.linen as nn
 
 # Logical-to-mesh sharding rules (see lddl_tpu.parallel.mesh for axes).
+#
+# "embed" names PARAM embed dims and maps to fsdp: with an fsdp mesh axis
+# the weights and optimizer state live fully sharded (ZeRO-style) and XLA
+# all-gathers each weight just-in-time for its matmul. Activations use
+# the separate "act_embed" name because their batch dim already rides
+# fsdp — one array cannot use the axis twice.
 LOGICAL_AXIS_RULES = (
     ("batch", ("dp", "fsdp")),
     ("seq", "sp"),
-    ("embed", None),
+    ("embed", "fsdp"),
+    ("act_embed", None),
     ("embed_out", None),
     ("mlp", "tp"),
     ("heads", "tp"),
@@ -134,7 +141,7 @@ class Embeddings(nn.Module):
                 _dense_init(cfg), (None, "embed")),
             name="token_type_embeddings")(token_type_ids)
         x = word + pos + typ
-        x = with_logical(x, ("batch", "seq", "embed"))
+        x = with_logical(x, ("batch", "seq", "act_embed"))
         x = nn.LayerNorm(epsilon=self.cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="layer_norm")(x)
         x = nn.Dropout(cfg.hidden_dropout)(x, deterministic=deterministic)
@@ -182,7 +189,7 @@ class EncoderLayer(nn.Module):
         h = nn.Dropout(cfg.hidden_dropout)(h, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="ffn_norm")(x + h)
-        return with_logical(x, ("batch", "seq", "embed"))
+        return with_logical(x, ("batch", "seq", "act_embed"))
 
 
 class BertForPreTraining(nn.Module):

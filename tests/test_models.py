@@ -84,7 +84,8 @@ def test_mesh_portability_same_loss(tiny_cfg):
     sharding must not change the math."""
     batch_np = _fake_batch(tiny_cfg, B=8, L=16, seed=5)
     losses = []
-    for axes in ({"dp": 8}, {"dp": 2, "tp": 4}, {"dp": 2, "tp": 2, "sp": 2}):
+    for axes in ({"dp": 8}, {"dp": 2, "tp": 4}, {"dp": 2, "tp": 2, "sp": 2},
+                 {"dp": 2, "fsdp": 2, "tp": 2}):
         mesh = make_mesh(axes)
         state, _ = create_train_state(tiny_cfg, mesh, batch_np, seed=11)
         ev = make_eval_step(mesh, tiny_cfg)
@@ -242,4 +243,25 @@ def test_bart_loader_to_model_e2e(tmp_path):
     step = make_sharded_train_step(mesh, cfg, model=model,
                                    batch_loss=bart_batch_loss)
     state, metrics = step(state, to_device_batch(batch_np, mesh), seed=0)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_fsdp_shards_params_and_optimizer(tiny_cfg):
+    """With an fsdp mesh axis, weights and adam state live fully sharded
+    (ZeRO-style): the 'embed' param dim maps to fsdp while the batch dim
+    still rides (dp, fsdp)."""
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    batch = _fake_batch(tiny_cfg, B=8, L=32)
+    state, _ = create_train_state(tiny_cfg, mesh, batch)
+    p = state.params
+    qkv = p["layer_0"]["attention"]["query"]["kernel"]
+    assert qkv.sharding.spec[0] == "fsdp" and qkv.sharding.spec[-1] == "tp"
+    emb = p["embeddings"]["word_embeddings"]["embedding"]
+    assert emb.sharding.spec == ("tp", "fsdp")
+    mu = state.opt_state[1][0].mu
+    assert mu["layer_0"]["attention"]["query"]["kernel"].sharding.spec[0] \
+        == "fsdp"
+    # The step runs and produces a finite loss.
+    step = make_sharded_train_step(mesh, tiny_cfg)
+    state, metrics = step(state, to_device_batch(batch, mesh), seed=0)
     assert np.isfinite(float(metrics["loss"]))
