@@ -67,6 +67,8 @@ def attach_args():
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--path", required=True, help="balanced shard dir")
     p.add_argument("--vocab-file", required=True)
+    p.add_argument("--family", choices=("bert", "bart"), default="bert",
+                   help="which loader/model contract to drive")
     p.add_argument("--batch-size", type=int, default=64)
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--start-epoch", type=int, default=0)
@@ -113,18 +115,43 @@ def main():
     args = attach_args().parse_args()
     from lddl_tpu.loader import get_bert_pretrain_data_loader, to_device_batch
 
-    loader = get_bert_pretrain_data_loader(
-        args.path,
-        dp_rank=args.dp_rank,
-        num_dp_groups=args.num_dp_groups,
-        batch_size=args.batch_size,
-        num_workers=args.num_workers,
-        vocab_file=args.vocab_file,
-        fixed_seq_lengths=args.fixed_seq_lengths,
-        base_seed=args.seed,
-        start_epoch=args.start_epoch,
-        return_raw_samples=args.debug,
-    )
+    if args.family == "bart":
+        from lddl_tpu.loader.bart import get_bart_pretrain_data_loader
+        if args.debug:
+            raise SystemExit("--debug is a BERT raw-sample inspector; "
+                             "the BART loader has no debug formatter")
+        if args.fixed_seq_lengths and len(args.fixed_seq_lengths) != 1:
+            raise SystemExit("--family bart takes a single "
+                             "--fixed-seq-lengths value (BART shards are "
+                             "unbinned)")
+        fixed = (args.fixed_seq_lengths[0] if args.fixed_seq_lengths
+                 else None)
+        loader = get_bart_pretrain_data_loader(
+            args.path,
+            dp_rank=args.dp_rank,
+            num_dp_groups=args.num_dp_groups,
+            batch_size=args.batch_size,
+            num_workers=args.num_workers,
+            vocab_file=args.vocab_file,
+            max_seq_length=fixed or 128,
+            fixed_seq_length=fixed,
+            base_seed=args.seed,
+            start_epoch=args.start_epoch,
+            return_raw_samples=args.debug,
+        )
+    else:
+        loader = get_bert_pretrain_data_loader(
+            args.path,
+            dp_rank=args.dp_rank,
+            num_dp_groups=args.num_dp_groups,
+            batch_size=args.batch_size,
+            num_workers=args.num_workers,
+            vocab_file=args.vocab_file,
+            fixed_seq_lengths=args.fixed_seq_lengths,
+            base_seed=args.seed,
+            start_epoch=args.start_epoch,
+            return_raw_samples=args.debug,
+        )
     if args.debug:
         from lddl_tpu.preprocess import get_tokenizer
         _debug_print(loader, get_tokenizer(vocab_file=args.vocab_file))
@@ -134,7 +161,18 @@ def main():
     mesh = None
     if args.with_model:
         import jax
-        from lddl_tpu.models import (BertConfig, create_train_state,
+        # Environments with an accelerator plugin registered at interpreter
+        # startup can shadow JAX_PLATFORMS; re-assert the env choice via
+        # config before first device use (no-op if already initialized).
+        if os.environ.get("JAX_PLATFORMS"):
+            try:
+                jax.config.update("jax_platforms",
+                                  os.environ["JAX_PLATFORMS"])
+            except RuntimeError:
+                pass
+        from lddl_tpu.models import (BartConfig, BartForPreTraining,
+                                     BertConfig, bart_batch_loss,
+                                     create_train_state,
                                      make_sharded_train_step)
         from lddl_tpu.parallel import make_mesh
         axes = {"dp": -1}
@@ -142,18 +180,29 @@ def main():
             axes = {k: int(v) for k, v in
                     (kv.split("=") for kv in args.mesh.split(","))}
         mesh = make_mesh(axes)
-        cfg = (BertConfig.tiny() if args.with_model == "tiny"
-               else BertConfig.bert_base())
         # Init from a synthetic batch: pulling one from the loader would
         # advance the dataset's epoch counter and skip the first epoch's
         # data (param init only needs the batch key/shape contract).
-        from lddl_tpu.models.testing import fake_pretrain_batch
         init_len = (args.fixed_seq_lengths[0] if args.fixed_seq_lengths
                     else 128)
-        sample = fake_pretrain_batch(cfg.vocab_size, args.batch_size,
+        if args.family == "bart":
+            cfg = (BartConfig.tiny() if args.with_model == "tiny"
+                   else BartConfig.bart_base())
+            from lddl_tpu.models.testing import fake_bart_batch
+            sample = fake_bart_batch(cfg.vocab_size, args.batch_size,
                                      init_len, seed=args.seed)
-        state, _ = create_train_state(cfg, mesh, sample)
-        step_fn = make_sharded_train_step(mesh, cfg)
+            model = BartForPreTraining(cfg)
+            state, _ = create_train_state(cfg, mesh, sample, model=model)
+            step_fn = make_sharded_train_step(
+                mesh, cfg, model=model, batch_loss=bart_batch_loss)
+        else:
+            cfg = (BertConfig.tiny() if args.with_model == "tiny"
+                   else BertConfig.bert_base())
+            from lddl_tpu.models.testing import fake_pretrain_batch
+            sample = fake_pretrain_batch(cfg.vocab_size, args.batch_size,
+                                         init_len, seed=args.seed)
+            state, _ = create_train_state(cfg, mesh, sample)
+            step_fn = make_sharded_train_step(mesh, cfg)
 
         def step(batch):
             nonlocal state
@@ -177,10 +226,13 @@ def main():
         for i, batch in enumerate(loader):
             n, L = batch["input_ids"].shape
             # Shape contracts (ref torch_train.py:171-175).
-            assert batch["token_type_ids"].shape == (n, L)
             assert batch["attention_mask"].shape == (n, L)
             assert batch["labels"].shape == (n, L)
-            assert batch["next_sentence_labels"].shape == (n,)
+            if args.family == "bart":
+                assert batch["decoder_input_ids"].shape == (n, L)
+            else:
+                assert batch["token_type_ids"].shape == (n, L)
+                assert batch["next_sentence_labels"].shape == (n,)
             lens = batch["attention_mask"].sum(axis=1)
             seq_len_hist.update(L, n)
             pad_hist.update(L, int((L - lens).sum()))

@@ -23,3 +23,19 @@ def fake_pretrain_batch(vocab_size, batch, seq_len, seed=0,
                            -1).astype(np.int32),
         "next_sentence_labels": rng.integers(0, 2, (batch,)).astype(np.int32),
     }
+
+
+def fake_bart_batch(vocab_size, batch, seq_len, seed=0):
+    """Synthetic batch matching the BART loader contract
+    (loader/bart.py: input_ids/attention_mask/decoder_input_ids/labels)."""
+    rng = np.random.default_rng(seed)
+    dec = rng.integers(5, vocab_size, (batch, seq_len)).astype(np.int32)
+    labels = np.roll(dec, -1, axis=1).astype(np.int32)
+    labels[:, -1] = -1
+    return {
+        "input_ids": rng.integers(5, vocab_size,
+                                  (batch, seq_len)).astype(np.int32),
+        "attention_mask": np.ones((batch, seq_len), np.int32),
+        "decoder_input_ids": dec,
+        "labels": labels,
+    }

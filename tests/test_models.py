@@ -114,22 +114,13 @@ def test_attention_mask_blocks_padding(tiny_cfg):
 
 
 def _fake_bart_batch(cfg, B=4, L=24, seed=0):
-    g = np.random.default_rng(seed)
-    input_ids = g.integers(5, cfg.vocab_size, (B, L)).astype(np.int32)
-    attention_mask = np.ones((B, L), np.int32)
-    attention_mask[0, L - 5:] = 0
-    input_ids[0, L - 5:] = 0
-    decoder_input_ids = g.integers(5, cfg.vocab_size, (B, L)).astype(np.int32)
-    labels = np.roll(decoder_input_ids, -1, axis=1).astype(np.int32)
-    labels[:, -1] = -1
+    from lddl_tpu.models.testing import fake_bart_batch
+    b = fake_bart_batch(cfg.vocab_size, B, L, seed=seed)
+    b["attention_mask"][0, L - 5:] = 0
+    b["input_ids"][0, L - 5:] = 0
     if B > 1:
-        labels[1, 10:] = -1  # padded targets ignored
-    return {
-        "input_ids": input_ids,
-        "attention_mask": attention_mask,
-        "decoder_input_ids": decoder_input_ids,
-        "labels": labels,
-    }
+        b["labels"][1, 10:] = -1  # padded targets ignored
+    return b
 
 
 def test_bart_forward_shapes():
