@@ -1,0 +1,49 @@
+"""Subprocess body for test_multiprocess_loader: builds the production
+loader under a real 2-process jax.distributed group. The shard census
+runs through JaxCommunicator (the .num_samples.json cache is removed by
+the test), then each rank reports (a) its dp-partition sample set and
+(b) a digest of the encoded batch stream for dp_rank=0 — which must be
+identical on every rank (TP/PP-peer contract)."""
+
+import hashlib
+import json
+import sys
+
+
+def main():
+    rank, world = int(sys.argv[1]), int(sys.argv[2])
+    coordinator, shards, vocab = sys.argv[3], sys.argv[4], sys.argv[5]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=world, process_id=rank)
+
+    from lddl_tpu.parallel.distributed import get_communicator
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    comm = get_communicator()
+
+    # (a) This rank's dp partition (raw samples, census via comm).
+    loader = get_bert_pretrain_data_loader(
+        shards, dp_rank=rank, num_dp_groups=world, vocab_file=vocab,
+        batch_size=8, base_seed=5, return_raw_samples=True, comm=comm)
+    mine = sorted(s[0] + "|" + s[1] for batch in loader for s in batch)
+    print("SAMPLES " + json.dumps(mine), flush=True)
+
+    # (b) TP-peer identity: every rank of dp group 0 must produce the
+    # exact same encoded batch stream.
+    comm.barrier()
+    loader0 = get_bert_pretrain_data_loader(
+        shards, dp_rank=0, num_dp_groups=world, vocab_file=vocab,
+        batch_size=8, base_seed=5, comm=comm)
+    h = hashlib.sha256()
+    for batch in loader0:
+        for key in sorted(batch):
+            h.update(batch[key].tobytes())
+    print("IDENTITY " + h.hexdigest(), flush=True)
+    comm.barrier()
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
