@@ -48,11 +48,15 @@ def mask_batch_numpy(ids, candidate, num_to_predict, g, mask_id, vocab_size,
     # non-candidates sit at +inf and the candidate guard excludes them
     # even when a short row's threshold is inf).
     num_to_predict = np.asarray(num_to_predict)
+    if ids.shape[0] == 0:
+        return ids.copy(), np.zeros_like(candidate)
+    # num_to_predict values beyond the row width clamp to "take every
+    # candidate" (the rank-based behavior).
     k_max = min(max(int(num_to_predict.max()), 1), ids.shape[1])
     smallest = np.partition(scores, k_max - 1, axis=1)[:, :k_max]
     smallest.sort(axis=1)
     thresh = smallest[np.arange(ids.shape[0]),
-                      np.maximum(num_to_predict, 1) - 1]
+                      np.clip(num_to_predict, 1, k_max) - 1]
     selected = (scores <= thresh[:, None]) & candidate
     selected[num_to_predict <= 0] = False
 

@@ -245,3 +245,22 @@ def test_wwm_e2e_both_engines(tmp_path, tiny_corpus):
     assert sorted(map(key, npy)) == sorted(map(key, jx))
     assert any(r["masked_lm_labels"] for r in npy)
     assert any(r["masked_lm_labels"] for r in jx)
+
+
+def test_mask_batch_numpy_degenerate_inputs():
+    """num_to_predict beyond the row width selects every candidate (the
+    rank-based behavior); an empty batch returns empty outputs."""
+    g = np.random.default_rng(4)
+    ids = g.integers(10, 1000, (6, 6)).astype(np.int32)
+    candidate = np.ones((6, 6), dtype=bool)
+    candidate[:, 0] = False
+    num = np.full(6, 8, dtype=np.int32)  # > L
+    masked, selected = mask_batch_numpy(ids, candidate, num,
+                                        lrng.sample_rng(2, 9), 3, 1000)
+    np.testing.assert_array_equal(selected, candidate)
+    empty_ids = np.zeros((0, 8), np.int32)
+    empty_cand = np.zeros((0, 8), bool)
+    m, s = mask_batch_numpy(empty_ids, empty_cand,
+                            np.zeros(0, np.int32),
+                            lrng.sample_rng(2, 10), 3, 1000)
+    assert m.shape == (0, 8) and s.shape == (0, 8)
