@@ -256,3 +256,36 @@ def test_fsdp_shards_params_and_optimizer(tiny_cfg):
     step = make_sharded_train_step(mesh, tiny_cfg)
     state, metrics = step(state, to_device_batch(batch, mesh), seed=0)
     assert np.isfinite(float(metrics["loss"]))
+
+
+@pytest.mark.parametrize("family", ("bert", "bart"))
+def test_remat_same_loss_and_grads(family):
+    """Rematerialized layers change memory, not math: one train step with
+    remat on/off from identical init produces identical loss and params."""
+    mesh = make_mesh({"dp": 2, "tp": 2, "sp": 2})
+    if family == "bert":
+        from lddl_tpu.models import BertConfig
+        cfgs = [BertConfig.tiny(remat=r) for r in (False, True)]
+        batch_np = _fake_batch(cfgs[0], B=4, L=32)
+        make_kwargs = [dict() for _ in cfgs]
+        models = [None, None]
+    else:
+        from lddl_tpu.models import (BartConfig, BartForPreTraining,
+                                     bart_batch_loss)
+        cfgs = [BartConfig.tiny(remat=r) for r in (False, True)]
+        batch_np = _fake_bart_batch(cfgs[0], B=4, L=32)
+        models = [BartForPreTraining(c) for c in cfgs]
+        make_kwargs = [dict(model=m, batch_loss=bart_batch_loss)
+                       for m in models]
+    losses, params = [], []
+    for cfg, m, kw in zip(cfgs, models, make_kwargs):
+        opt = make_optimizer(warmup_steps=1, total_steps=5)
+        state, _ = create_train_state(cfg, mesh, batch_np, model=m,
+                                      optimizer=opt)
+        step = make_sharded_train_step(mesh, cfg, **kw)
+        state, metrics = step(state, to_device_batch(batch_np, mesh),
+                              seed=0)
+        losses.append(float(metrics["loss"]))
+        params.append(jax.device_get(jax.tree.leaves(state.params)[0]))
+    assert losses[0] == losses[1], losses
+    np.testing.assert_array_equal(params[0], params[1])
