@@ -7,6 +7,7 @@ from .masking import (
     make_jax_whole_word_masker,
 )
 from .packing import pad_to_bucket, round_up
+from .flash_attention import flash_attention
 from .ring_attention import dense_attention_reference, ring_attention
 
 __all__ = [
@@ -19,5 +20,6 @@ __all__ = [
     "pad_to_bucket",
     "round_up",
     "ring_attention",
+    "flash_attention",
     "dense_attention_reference",
 ]

@@ -1,0 +1,156 @@
+"""Pallas fused attention (flash-style online softmax) for TPU.
+
+The hot op of the model stack as a hand-written TPU kernel: per
+(batch, head), Q blocks stream through VMEM while the kernel walks K/V
+in blocks under a running-max/denominator softmax — the L x L score
+matrix never exists in HBM, scores accumulate in fp32 on the MXU
+(``preferred_element_type``), and the output is written once per Q
+block.
+
+Scope (documented, tested):
+- forward: the pallas kernel (grid (B*H, L/TQ), K/V resident in VMEM per
+  (batch, head) — the right regime for L up to a few thousand; VMEM is
+  ~16 MiB/core).
+- backward: jax.custom_vjp recomputing through the XLA dense reference
+  (bit-compatible semantics, standard recompute fallback); a pallas
+  backward kernel is future work.
+- numerics match ops.ring_attention.dense_attention_reference (same
+  finite -1e9 padding bias), pinned by interpret-mode tests on CPU; the
+  kernel compiles and runs on a real TPU chip via the same entry point.
+
+``interpret=None`` auto-selects: real pallas lowering on TPU, interpret
+mode elsewhere (CPU CI).
+"""
+
+import functools
+
+# jax imported inside functions: the offline pipeline stages must stay
+# importable (via lddl_tpu.ops) on machines where jax is absent/broken.
+
+_TQ = 128   # Q rows per program (8x128-aligned for fp32 tiles)
+_TK = 128   # K/V rows per inner step
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, n_kv):
+    import jax
+    import jax.numpy as jnp
+
+    q = q_ref[0].astype(jnp.float32)            # [TQ, D]
+    tq, d = q.shape
+
+    def body(j, carry):
+        m, l, acc = carry
+        import jax.experimental.pallas as pl
+        k_blk = k_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
+        msk = mask_ref[0, 0, pl.ds(j * _TK, _TK)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [TQ, TK]
+        s = s + jnp.where(msk[None, :] > 0, 0.0, -1e9)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                            # [TQ, TK]
+        corr = jnp.exp(m - m_new)                         # [TQ, 1]
+        l_new = l * corr + p.sum(axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((tq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((tq, 1), jnp.float32)
+    acc0 = jnp.zeros((tq, d), jnp.float32)
+    import jax.lax as lax
+    _, l, acc = lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def flash_attention_fwd(q, k, v, kv_mask, interpret=None):
+    """Fused attention forward: q/k/v [B, L, H, D], kv_mask [B, L]
+    (1 = attend). Returns [B, L, H, D]; fp32 accumulation, output in
+    q.dtype. L is padded to a 128 multiple internally (padded keys are
+    masked; padded query rows are dropped on return)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, l, h, d = q.shape
+    l_pad = -(-l // _TQ) * _TQ
+    if l_pad != l:
+        pad = ((0, 0), (0, l_pad - l), (0, 0), (0, 0))
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, l_pad - l)))
+
+    # [B, L, H, D] -> [B*H, L, D]; mask tiled per head.
+    def to_bh(t):
+        return t.transpose(0, 2, 1, 3).reshape(b * h, l_pad, d)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    # [B, 1, L]: the trailing (1, L) block satisfies the TPU tiling rule
+    # (last two dims equal to the array's); the index map shares one mask
+    # copy across the H head-programs instead of materializing B*H copies.
+    maskb = kv_mask.astype(jnp.int32).reshape(b, 1, l_pad)
+
+    scale = 1.0 / (d ** 0.5)
+    n_kv = l_pad // _TK
+    kernel = functools.partial(_fwd_kernel, scale=scale, n_kv=n_kv)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, l_pad // _TQ),
+        in_specs=[
+            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((1, 1, l_pad),
+                         lambda bh, qi: (bh // h, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, l_pad, d), q.dtype),
+        interpret=interpret,
+    )(qb, kb, vb, maskb)
+    out = out.reshape(b, h, l_pad, d).transpose(0, 2, 1, 3)
+    return out[:, :l]
+
+
+_FLASH_VJP = None
+
+
+def _build_vjp():
+    """custom_vjp built on first use (keeps this module importable
+    without jax)."""
+    global _FLASH_VJP
+    if _FLASH_VJP is not None:
+        return _FLASH_VJP
+    import jax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+    def fa(q, k, v, kv_mask, interpret):
+        return flash_attention_fwd(q, k, v, kv_mask, interpret=interpret)
+
+    def fa_fwd(q, k, v, kv_mask, interpret):
+        out = flash_attention_fwd(q, k, v, kv_mask, interpret=interpret)
+        return out, (q, k, v, kv_mask)
+
+    def fa_bwd(interpret, residuals, ct):
+        from .ring_attention import dense_attention_reference
+        q, k, v, kv_mask = residuals
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: dense_attention_reference(q_, k_, v_,
+                                                         kv_mask),
+            q, k, v)
+        dq, dk, dv = vjp(ct)
+        return dq, dk, dv, None
+
+    fa.defvjp(fa_fwd, fa_bwd)
+    _FLASH_VJP = fa
+    return fa
+
+
+def flash_attention(q, k, v, kv_mask, interpret=None):
+    """Differentiable fused attention: pallas forward, recompute-through-
+    dense backward (see module docstring)."""
+    return _build_vjp()(q, k, v, kv_mask, interpret)
