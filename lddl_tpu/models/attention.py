@@ -57,9 +57,12 @@ class MultiHeadAttention(nn.Module):
             t = t.reshape(t.shape[0], t.shape[1], self.num_heads, head_dim)
             return with_logical(t, ("batch", seq_ax, "heads", "kv"))
 
+        # Blockwise impls serve bidirectional self-attention with a plain
+        # padding mask; causal/cross calls always take the dense path.
+        blockwise_ok = (q_input is kv_input and extra_bias is None
+                        and padding_mask is not None)
         use_ring = False
-        if (self.attention_impl == "ring" and q_input is kv_input
-                and extra_bias is None and padding_mask is not None):
+        if self.attention_impl == "ring" and blockwise_ok:
             from jax.sharding import get_abstract_mesh
             mesh = get_abstract_mesh()
             use_ring = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
@@ -74,6 +77,15 @@ class MultiHeadAttention(nn.Module):
             k = split_heads(proj("key")(kv_input), "seq")
             v = split_heads(proj("value")(kv_input), "seq")
             ctx = ring_attention(q, k, v, padding_mask, mesh)
+        elif self.attention_impl == "flash" and blockwise_ok:
+            # The pallas fused kernel (ops/flash_attention.py); attention-
+            # prob dropout is skipped, like ring.
+            from ..ops.flash_attention import flash_attention
+
+            q = split_heads(proj("query")(q_input), None)
+            k = split_heads(proj("key")(kv_input), None)
+            v = split_heads(proj("value")(kv_input), None)
+            ctx = flash_attention(q, k, v, padding_mask)
         else:
             # Full-sequence attention: entering this block the activations
             # all-gather from sp, and heads shard over tp.

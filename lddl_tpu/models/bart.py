@@ -53,8 +53,8 @@ class BartConfig:
     remat: bool = False
 
     def __post_init__(self):
-        if self.attention_impl not in ("dense", "ring"):
-            raise ValueError("attention_impl must be dense|ring")
+        if self.attention_impl not in ("dense", "ring", "flash"):
+            raise ValueError("attention_impl must be dense|ring|flash")
 
     @staticmethod
     def bart_base(**kw):

@@ -92,8 +92,8 @@ class BertConfig:
     remat: bool = False
 
     def __post_init__(self):
-        if self.attention_impl not in ("dense", "ring"):
-            raise ValueError("attention_impl must be dense|ring")
+        if self.attention_impl not in ("dense", "ring", "flash"):
+            raise ValueError("attention_impl must be dense|ring|flash")
 
     @staticmethod
     def bert_base(**kw):

@@ -60,3 +60,36 @@ def test_jit_composes():
     ref = dense_attention_reference(q, k, v, mask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_bert_flash_matches_dense_logits():
+    """attention_impl='flash' in the full model (interpret mode off-TPU)
+    matches dense logits with shared params."""
+    import flax.linen as nn
+    from lddl_tpu.models import BertConfig, BertForPreTraining
+    from lddl_tpu.models.testing import fake_pretrain_batch
+
+    cfg_kw = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                  intermediate_size=64, max_position_embeddings=128,
+                  dtype=jnp.float32)
+    cfg_d = BertConfig(attention_impl="dense", **cfg_kw)
+    cfg_f = BertConfig(attention_impl="flash", **cfg_kw)
+    batch = fake_pretrain_batch(cfg_d.vocab_size, 2, 128, seed=1)
+    model_d = BertForPreTraining(cfg_d)
+    model_f = BertForPreTraining(cfg_f)
+    params = nn.meta.unbox(model_d.init(
+        jax.random.PRNGKey(0), batch["input_ids"],
+        batch["token_type_ids"], batch["attention_mask"],
+        deterministic=True))["params"]
+
+    def fwd(model):
+        return model.apply({"params": params}, batch["input_ids"],
+                           batch["token_type_ids"], batch["attention_mask"],
+                           deterministic=True)
+
+    mlm_d, nsp_d = fwd(model_d)
+    mlm_f, nsp_f = fwd(model_f)
+    np.testing.assert_allclose(np.asarray(mlm_f), np.asarray(mlm_d),
+                               rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(np.asarray(nsp_f), np.asarray(nsp_d),
+                               rtol=5e-4, atol=5e-4)
