@@ -40,6 +40,18 @@ def main():
         err = float(np.abs(np.asarray(fa(q, k, v, mask), np.float32)
                            - np.asarray(dn(q, k, v, mask),
                                         np.float32)).max())
+        # Gradient parity on hardware: pallas backward vs XLA dense vjp.
+        gf = jax.jit(jax.grad(
+            lambda q_, k_, v_: (flash_attention(q_, k_, v_, mask)
+                                .astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2)))(q, k, v)
+        gd = jax.jit(jax.grad(
+            lambda q_, k_, v_: (dense_attention_reference(q_, k_, v_, mask)
+                                .astype(jnp.float32) ** 2).sum(),
+            argnums=(0, 1, 2)))(q, k, v)
+        gerr = float(max(np.abs(np.asarray(a, np.float32)
+                                - np.asarray(b_, np.float32)).max()
+                         for a, b_ in zip(gf, gd)))
         t0 = time.perf_counter()
         for _ in range(5):
             fa(q, k, v, mask).block_until_ready()
@@ -49,6 +61,7 @@ def main():
             dn(q, k, v, mask).block_until_ready()
         t_dn = (time.perf_counter() - t0) / 5
         results.append(dict(shape=[b, l, h, d], max_abs_err=err,
+                            grad_max_abs_err=gerr,
                             pallas_ms=round(t_fa * 1e3, 2),
                             xla_dense_ms=round(t_dn * 1e3, 2)))
         print(results[-1], flush=True)

@@ -1,22 +1,22 @@
 """Pallas fused attention (flash-style online softmax) for TPU.
 
-The hot op of the model stack as a hand-written TPU kernel: per
+The hot op of the model stack as hand-written TPU kernels: per
 (batch, head), Q blocks stream through VMEM while the kernel walks K/V
 in blocks under a running-max/denominator softmax — the L x L score
 matrix never exists in HBM, scores accumulate in fp32 on the MXU
 (``preferred_element_type``), and the output is written once per Q
-block.
+block. The backward pass is pallas too (the standard flash recipe): the
+forward saves the per-row log-sum-exp, backward recomputes P blockwise
+from (Q, K, LSE) and accumulates dQ in a Q-block kernel and dK/dV in a
+KV-block kernel — no L x L materialization anywhere in training either.
 
 Scope (documented, tested):
-- forward: the pallas kernel (grid (B*H, L/TQ), K/V resident in VMEM per
-  (batch, head) — the right regime for L up to a few thousand; VMEM is
-  ~16 MiB/core).
-- backward: jax.custom_vjp recomputing through the XLA dense reference
-  (bit-compatible semantics, standard recompute fallback); a pallas
-  backward kernel is future work.
+- K/V (and in backward Q/dO) are VMEM-resident per (batch, head) — the
+  right regime for L up to a few thousand (VMEM is ~16 MiB/core).
 - numerics match ops.ring_attention.dense_attention_reference (same
-  finite -1e9 padding bias), pinned by interpret-mode tests on CPU; the
-  kernel compiles and runs on a real TPU chip via the same entry point.
+  finite -1e9 padding bias), pinned by interpret-mode tests on CPU for
+  forward AND gradients; the kernels compile and run on a real TPU chip
+  via the same entry point (FLASH_ATTENTION_BENCH.json).
 
 ``interpret=None`` auto-selects: real pallas lowering on TPU, interpret
 mode elsewhere (CPU CI).
@@ -31,74 +31,149 @@ _TQ = 128   # Q rows per program (8x128-aligned for fp32 tiles)
 _TK = 128   # K/V rows per inner step
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, *, scale, n_kv):
+def _dot(a, b, transpose_b=False):
     import jax
     import jax.numpy as jnp
+    dims = (((1,), (1,)), ((), ())) if transpose_b else (((1,), (0,)),
+                                                         ((), ()))
+    return jax.lax.dot_general(a, b, dims,
+                               preferred_element_type=jnp.float32)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale,
+                n_kv):
+    import jax.numpy as jnp
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
 
     q = q_ref[0].astype(jnp.float32)            # [TQ, D]
     tq, d = q.shape
 
     def body(j, carry):
         m, l, acc = carry
-        import jax.experimental.pallas as pl
         k_blk = k_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
         msk = mask_ref[0, 0, pl.ds(j * _TK, _TK)]
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [TQ, TK]
+        s = _dot(q, k_blk, transpose_b=True) * scale      # [TQ, TK]
         s = s + jnp.where(msk[None, :] > 0, 0.0, -1e9)
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)                            # [TQ, TK]
         corr = jnp.exp(m - m_new)                         # [TQ, 1]
         l_new = l * corr + p.sum(axis=1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_new = acc * corr + _dot(p, v_blk)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((tq, 1), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((tq, 1), jnp.float32)
     acc0 = jnp.zeros((tq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m[:, 0] + jnp.log(l[:, 0])).astype(lse_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, *, scale, n_kv):
+    import jax.numpy as jnp
     import jax.lax as lax
-    _, l, acc = lax.fori_loop(0, n_kv, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    from jax.experimental import pallas as pl
+
+    q = q_ref[0].astype(jnp.float32)             # [TQ, D]
+    do = do_ref[0].astype(jnp.float32)           # [TQ, D]
+    lse = lse_ref[0, 0][:, None]                 # [TQ, 1]
+    delta = delta_ref[0, 0][:, None]             # [TQ, 1]
+    tq, d = q.shape
+
+    def body(j, dq_acc):
+        k_blk = k_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
+        msk = mask_ref[0, 0, pl.ds(j * _TK, _TK)]
+        s = _dot(q, k_blk, transpose_b=True) * scale
+        s = s + jnp.where(msk[None, :] > 0, 0.0, -1e9)
+        p = jnp.exp(s - lse)                     # [TQ, TK]
+        dp = _dot(do, v_blk, transpose_b=True)   # [TQ, TK]
+        ds = p * (dp - delta) * scale
+        return dq_acc + _dot(ds, k_blk)
+
+    dq = lax.fori_loop(0, n_kv, body, jnp.zeros((tq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *, scale, n_q):
+    import jax.numpy as jnp
+    import jax.lax as lax
+    from jax.experimental import pallas as pl
+
+    k = k_ref[0].astype(jnp.float32)             # [TK, D]
+    v = v_ref[0].astype(jnp.float32)             # [TK, D]
+    msk = mask_ref[0, 0]                         # [TK] (this KV block)
+    tk, d = k.shape
+    bias = jnp.where(msk[:, None] > 0, 0.0, -1e9)  # [TK, 1]
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(i * _TQ, _TQ), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(i * _TQ, _TQ), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * _TQ, _TQ)][None, :]    # [1, TQ]
+        delta = delta_ref[0, 0, pl.ds(i * _TQ, _TQ)][None, :]
+        # s^T layout: [TK, TQ]
+        st = _dot(k, q_blk, transpose_b=True) * scale + bias
+        pt = jnp.exp(st - lse)                   # [TK, TQ]
+        dv_acc = dv_acc + _dot(pt, do_blk)       # [TK, D]
+        dpt = _dot(v, do_blk, transpose_b=True)  # [TK, TQ]
+        dst = pt * (dpt - delta) * scale
+        dk_acc = dk_acc + _dot(dst, q_blk)       # [TK, D]
+        return dk_acc, dv_acc
+
+    zero = jnp.zeros((tk, d), jnp.float32)
+    dk, dv = lax.fori_loop(0, n_q, body, (zero, zero))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _prep_one(t, l_pad):
+    """Pad one [B, L, H, D] tensor to l_pad rows and move it to the
+    [B*H, L, D] kernel layout."""
+    import jax.numpy as jnp
+    b, l, h, d = t.shape
+    if l_pad != l:
+        t = jnp.pad(t, ((0, 0), (0, l_pad - l), (0, 0), (0, 0)))
+    return t.transpose(0, 2, 1, 3).reshape(b * h, l_pad, d)
+
+
+def _prep(q, k, v, kv_mask):
+    """Pad L to a block multiple and move to the [B*H, L, D] kernel
+    layout. Returns (qb, kb, vb, maskb[B,1,Lp], shapes)."""
+    import jax.numpy as jnp
+    b, l, h, d = q.shape
+    l_pad = -(-l // _TQ) * _TQ
+    if l_pad != l:
+        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, l_pad - l)))
+    maskb = kv_mask.astype(jnp.int32).reshape(b, 1, l_pad)
+    return (_prep_one(q, l_pad), _prep_one(k, l_pad), _prep_one(v, l_pad),
+            maskb, (b, l, h, d, l_pad))
+
+
+def _from_bh(t, b, l, h, d):
+    return t.reshape(b, h, -1, d).transpose(0, 2, 1, 3)[:, :l]
 
 
 def flash_attention_fwd(q, k, v, kv_mask, interpret=None):
     """Fused attention forward: q/k/v [B, L, H, D], kv_mask [B, L]
-    (1 = attend). Returns [B, L, H, D]; fp32 accumulation, output in
-    q.dtype. L is padded to a 128 multiple internally (padded keys are
-    masked; padded query rows are dropped on return)."""
+    (1 = attend). Returns (out [B, L, H, D], lse [B*H, 1, L_pad]); fp32
+    accumulation, output in q.dtype. L pads to a 128 multiple internally
+    (padded keys are masked; padded query rows are dropped on return)."""
     import jax
-    import jax.numpy as jnp
     from jax.experimental import pallas as pl
+    import jax.numpy as jnp
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    b, l, h, d = q.shape
-    l_pad = -(-l // _TQ) * _TQ
-    if l_pad != l:
-        pad = ((0, 0), (0, l_pad - l), (0, 0), (0, 0))
-        q = jnp.pad(q, pad)
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
-        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, l_pad - l)))
-
-    # [B, L, H, D] -> [B*H, L, D]; mask tiled per head.
-    def to_bh(t):
-        return t.transpose(0, 2, 1, 3).reshape(b * h, l_pad, d)
-
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
-    # [B, 1, L]: the trailing (1, L) block satisfies the TPU tiling rule
-    # (last two dims equal to the array's); the index map shares one mask
-    # copy across the H head-programs instead of materializing B*H copies.
-    maskb = kv_mask.astype(jnp.int32).reshape(b, 1, l_pad)
-
+    qb, kb, vb, maskb, (b, l, h, d, l_pad) = _prep(q, k, v, kv_mask)
     scale = 1.0 / (d ** 0.5)
-    n_kv = l_pad // _TK
-    kernel = functools.partial(_fwd_kernel, scale=scale, n_kv=n_kv)
-    out = pl.pallas_call(
+    kernel = functools.partial(_fwd_kernel, scale=scale, n_kv=l_pad // _TK)
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, l_pad // _TQ),
         in_specs=[
@@ -108,12 +183,79 @@ def flash_attention_fwd(q, k, v, kv_mask, interpret=None):
             pl.BlockSpec((1, 1, l_pad),
                          lambda bh, qi: (bh // h, 0, 0)),
         ],
+        out_specs=[
+            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, _TQ), lambda bh, qi: (bh, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, l_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 1, l_pad), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, maskb)
+    return _from_bh(out, b, l, h, d), lse
+
+
+def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None):
+    """Pallas backward: recomputes P blockwise from (Q, K, LSE); dQ from a
+    Q-block kernel, dK/dV from a KV-block kernel."""
+    import jax
+    from jax.experimental import pallas as pl
+    import jax.numpy as jnp
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    qb, kb, vb, maskb, (b, l, h, d, l_pad) = _prep(q, k, v, kv_mask)
+    dob = _prep_one(ct, l_pad)
+    ob = _prep_one(out, l_pad)
+    scale = 1.0 / (d ** 0.5)
+    # delta_i = sum_d dO_id * O_id, per query row.
+    delta = (dob.astype(jnp.float32) * ob.astype(jnp.float32)).sum(
+        axis=-1).reshape(b * h, 1, l_pad)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, n_kv=l_pad // _TK),
+        grid=(b * h, l_pad // _TQ),
+        in_specs=[
+            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),   # q
+            pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),  # k
+            pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),  # v
+            pl.BlockSpec((1, 1, l_pad),
+                         lambda bh, qi: (bh // h, 0, 0)),            # mask
+            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),   # do
+            pl.BlockSpec((1, 1, _TQ), lambda bh, qi: (bh, 0, qi)),   # lse
+            pl.BlockSpec((1, 1, _TQ), lambda bh, qi: (bh, 0, qi)),   # delta
+        ],
         out_specs=pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, l_pad, d), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb, maskb)
-    out = out.reshape(b, h, l_pad, d).transpose(0, 2, 1, 3)
-    return out[:, :l]
+    )(qb, kb, vb, maskb, dob, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, n_q=l_pad // _TQ),
+        grid=(b * h, l_pad // _TK),
+        in_specs=[
+            pl.BlockSpec((1, l_pad, d), lambda bh, ki: (bh, 0, 0)),  # q
+            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),   # k
+            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),   # v
+            pl.BlockSpec((1, 1, _TK),
+                         lambda bh, ki: (bh // h, 0, ki)),           # mask
+            pl.BlockSpec((1, l_pad, d), lambda bh, ki: (bh, 0, 0)),  # do
+            pl.BlockSpec((1, 1, l_pad), lambda bh, ki: (bh, 0, 0)),  # lse
+            pl.BlockSpec((1, 1, l_pad), lambda bh, ki: (bh, 0, 0)),  # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, l_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, l_pad, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qb, kb, vb, maskb, dob, lse, delta)
+    return (_from_bh(dq, b, l, h, d), _from_bh(dk, b, l, h, d),
+            _from_bh(dv, b, l, h, d))
 
 
 _FLASH_VJP = None
@@ -129,20 +271,18 @@ def _build_vjp():
 
     @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
     def fa(q, k, v, kv_mask, interpret):
-        return flash_attention_fwd(q, k, v, kv_mask, interpret=interpret)
+        out, _ = flash_attention_fwd(q, k, v, kv_mask, interpret=interpret)
+        return out
 
     def fa_fwd(q, k, v, kv_mask, interpret):
-        out = flash_attention_fwd(q, k, v, kv_mask, interpret=interpret)
-        return out, (q, k, v, kv_mask)
+        out, lse = flash_attention_fwd(q, k, v, kv_mask,
+                                       interpret=interpret)
+        return out, (q, k, v, kv_mask, out, lse)
 
     def fa_bwd(interpret, residuals, ct):
-        from .ring_attention import dense_attention_reference
-        q, k, v, kv_mask = residuals
-        _, vjp = jax.vjp(
-            lambda q_, k_, v_: dense_attention_reference(q_, k_, v_,
-                                                         kv_mask),
-            q, k, v)
-        dq, dk, dv = vjp(ct)
+        q, k, v, kv_mask, out, lse = residuals
+        dq, dk, dv = flash_attention_bwd(q, k, v, kv_mask, out, lse, ct,
+                                         interpret=interpret)
         return dq, dk, dv, None
 
     fa.defvjp(fa_fwd, fa_bwd)
@@ -151,6 +291,6 @@ def _build_vjp():
 
 
 def flash_attention(q, k, v, kv_mask, interpret=None):
-    """Differentiable fused attention: pallas forward, recompute-through-
-    dense backward (see module docstring)."""
+    """Differentiable fused attention: pallas forward AND backward (see
+    module docstring)."""
     return _build_vjp()(q, k, v, kv_mask, interpret)
