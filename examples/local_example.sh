@@ -67,4 +67,23 @@ echo "== 6. validate binning + sync =="
 python benchmarks/validate_seqlen.py \
   --seq-len-dir "$DATA/seqlens" --bin-size "$BIN_SIZE"
 
+echo "== 7. BART family (preprocess -> balance -> loader) =="
+python -m lddl_tpu.cli.preprocess_bart_pretrain \
+  --wikipedia "$DATA/wiki" \
+  --sink "$DATA/bart_pre" \
+  --target-seq-length 128 \
+  --num-blocks 8 \
+  --sample-ratio 1.0 \
+  --seed 0
+python -m lddl_tpu.cli.balance_shards \
+  --indir "$DATA/bart_pre" --outdir "$DATA/bart_bal" --num-shards 4
+python benchmarks/mock_train.py \
+  --family bart \
+  --path "$DATA/bart_bal" \
+  --vocab-file "$DATA/vocab.txt" \
+  --batch-size 32 \
+  --epochs 1 \
+  --log-freq 20 \
+  --fixed-seq-lengths 128
+
 echo "example complete: $DATA"
