@@ -21,7 +21,7 @@ TPU design notes:
 """
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import jax.numpy as jnp
 import flax.linen as nn
