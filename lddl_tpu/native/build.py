@@ -87,7 +87,15 @@ def ensure_built(verbose=False):
                 fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
                 os.close(fd)
                 try:
-                    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                    # -march=native: the engine builds lazily on the
+                    # machine that runs it (2x on the WordPiece/UTF-8 hot
+                    # loops vs plain -O3). Heterogeneous fleets sharing
+                    # one prebuilt image can pin a baseline arch via
+                    # LDDL_TPU_NATIVE_MARCH (e.g. x86-64-v2).
+                    march = os.environ.get("LDDL_TPU_NATIVE_MARCH",
+                                           "native")
+                    cmd = ["g++", "-O3", "-march=" + march, "-std=c++17",
+                           "-shared", "-fPIC",
                            SRC, "-o", tmp]
                     proc = subprocess.run(cmd, capture_output=True, text=True)
                     if proc.returncode != 0:
