@@ -212,6 +212,7 @@ def main():
             "vs_baseline": round(value / REFERENCE_MB_PER_SEC_PER_CHIP, 3),
             "config": {
                 "num_workers": workers,
+                "host_cpu_count": os.cpu_count(),
                 "corpus_mb": round(main_bytes / 1024 / 1024, 2),
                 "n_samples": n_samples,
                 "lexicon_distinct_types": n_distinct,

@@ -86,6 +86,10 @@ def attach_args():
     p.add_argument("--mesh", default=None,
                    help="axes for --with-model, e.g. dp=2,tp=2,sp=2 "
                         "(default: all devices on dp)")
+    p.add_argument("--attention-impl", choices=("dense", "ring", "flash"),
+                   default="dense", help="for --with-model")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize layers (--with-model)")
     return p
 
 
@@ -186,8 +190,10 @@ def main():
         init_len = (args.fixed_seq_lengths[0] if args.fixed_seq_lengths
                     else 128)
         if args.family == "bart":
-            cfg = (BartConfig.tiny() if args.with_model == "tiny"
-                   else BartConfig.bart_base())
+            cfg = (BartConfig.tiny if args.with_model == "tiny"
+                   else BartConfig.bart_base)(
+                       attention_impl=args.attention_impl,
+                       remat=args.remat)
             from lddl_tpu.models.testing import fake_bart_batch
             sample = fake_bart_batch(cfg.vocab_size, args.batch_size,
                                      init_len, seed=args.seed)
@@ -196,8 +202,10 @@ def main():
             step_fn = make_sharded_train_step(
                 mesh, cfg, model=model, batch_loss=bart_batch_loss)
         else:
-            cfg = (BertConfig.tiny() if args.with_model == "tiny"
-                   else BertConfig.bert_base())
+            cfg = (BertConfig.tiny if args.with_model == "tiny"
+                   else BertConfig.bert_base)(
+                       attention_impl=args.attention_impl,
+                       remat=args.remat)
             from lddl_tpu.models.testing import fake_pretrain_batch
             sample = fake_pretrain_batch(cfg.vocab_size, args.batch_size,
                                          init_len, seed=args.seed)
