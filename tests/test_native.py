@@ -360,3 +360,19 @@ def test_native_join_matches_python_fallback(hf_tokenizer):
         native_mod.join_tokens = orig
     assert a.equals(b)
     assert a.to_pylist()[:3] == b.to_pylist()[:3]
+
+
+def test_memo_cap_degenerate_values(hf_tokenizer):
+    """Huge/zero memo caps must neither abort nor hang (the flat table
+    clamps its pre-size; caps only ever bound memory)."""
+    from lddl_tpu.native import NativeTokenizer
+    id_to_token = [hf_tokenizer.convert_ids_to_tokens(i)
+                   for i in range(len(hf_tokenizer))]
+    unk = hf_tokenizer.convert_tokens_to_ids("[UNK]")
+    ref = NativeTokenizer(id_to_token, unk).tokenize_docs(DOCS)
+    for cap in (0, 1, 2**40, 2**63):
+        nat = NativeTokenizer(id_to_token, unk, memo_cap=cap)
+        got = nat.tokenize_docs(DOCS)
+        import numpy as np
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(a, b)
