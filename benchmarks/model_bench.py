@@ -1,0 +1,177 @@
+"""Real-chip model-stack benchmark: step time + achieved MFU for the jitted
+BERT-base pretraining train step, dense vs pallas flash attention.
+
+The whole point vs FLASH_ATTENTION_BENCH.json: every timed dispatch runs
+``n_steps`` optimizer steps inside ONE XLA computation
+(models.make_sharded_multi_step's lax.scan), so the ~100 ms tunneled-chip
+dispatch floor is amortized to noise and the recorded per-step time is the
+device's, not the host's.
+
+MFU counts matmul FLOPs only (the standard convention): per token forward,
+``layers*(8h^2 + 4h*ffn + 4L*h) + 2h*vocab + 2h^2``, and training = 3x
+forward (backward is 2x). attention_dropout is 0 for both impls so dense
+and flash run the same math (flash, like ring, never applies prob dropout).
+
+Writes MODEL_BENCH.json at the repo root. Reference consumer contract this
+replaces: the mock trainer's loader-only throughput print
+(/root/reference/benchmarks/torch_train.py:188-199) — the reference has no
+model, so this file is the rebuild's beyond-parity perf record.
+
+Usage: python benchmarks/model_bench.py [--quick]
+  --quick: tiny model/shapes, CPU-friendly smoke test of the harness.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np
+
+# Peak dense bf16 TFLOP/s by device kind (public spec sheets).
+PEAK_BF16_TFLOPS = {
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v5p": 459.0,
+    "TPU v4": 275.0,
+    "TPU v6 lite": 918.0,   # v6e / Trillium
+}
+
+
+def matmul_flops_per_step(cfg, batch, seq_len):
+    h, ffn = cfg.hidden_size, cfg.intermediate_size
+    per_token_fwd = (
+        cfg.num_layers * (8 * h * h + 4 * h * ffn + 4 * seq_len * h)
+        + 2 * h * cfg.vocab_size  # tied MLM decode over all positions
+        + 2 * h * h               # MLM transform
+    )
+    mult = 4 if cfg.remat else 3  # remat recomputes the forward in bwd
+    return mult * per_token_fwd * batch * seq_len
+
+
+def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
+    import jax
+    from lddl_tpu.loader import to_device_step_batches
+    from lddl_tpu.models import create_train_state, make_sharded_multi_step
+    from lddl_tpu.models.testing import fake_pretrain_batch
+    from lddl_tpu.models.train import make_optimizer
+
+    batches = [fake_pretrain_batch(cfg.vocab_size, batch, seq_len,
+                                   seed=1000 + i, segment_split=True)
+               for i in range(n_steps)]
+    stacked_np = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+
+    state, _ = create_train_state(
+        cfg, mesh, batches[0],
+        optimizer=make_optimizer(warmup_steps=10,
+                                 total_steps=n_steps * (reps + 1) + 10))
+    multi = make_sharded_multi_step(mesh, cfg, n_steps)
+    stacked = to_device_step_batches(stacked_np, mesh)
+
+    # Warmup dispatch: compile + first run.
+    t0 = time.perf_counter()
+    state, metrics = multi(state, stacked, seed=0)
+    jax.block_until_ready(metrics)
+    warmup_s = time.perf_counter() - t0
+    first_loss = float(np.asarray(metrics["loss"])[0])
+
+    t0 = time.perf_counter()
+    for r in range(reps):
+        state, metrics = multi(state, stacked, seed=r + 1)
+    jax.block_until_ready(metrics)
+    elapsed = time.perf_counter() - t0
+
+    last_loss = float(np.asarray(metrics["loss"])[-1])
+    step_s = elapsed / (reps * n_steps)
+    flops = matmul_flops_per_step(cfg, batch, seq_len)
+    row = {
+        "attention_impl": cfg.attention_impl,
+        "batch": batch,
+        "seq_len": seq_len,
+        "remat": cfg.remat,
+        "n_steps_per_dispatch": n_steps,
+        "timed_steps": reps * n_steps,
+        "step_ms": round(step_s * 1e3, 3),
+        "tokens_per_s": round(batch * seq_len / step_s, 1),
+        "model_tflops_per_step": round(flops / 1e12, 3),
+        "mfu": round(flops / step_s / peak_flops, 4) if peak_flops else None,
+        "first_loss": round(first_loss, 4),
+        "last_loss": round(last_loss, 4),
+        "warmup_dispatch_s": round(warmup_s, 2),
+    }
+    assert np.isfinite(first_loss) and np.isfinite(last_loss), row
+    # Free the donated-state chain before the next config compiles.
+    del state, metrics, stacked
+    return row
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="tiny shapes on whatever backend is resolved")
+    p.add_argument("--n-steps", type=int, default=None,
+                   help="optimizer steps per dispatch (default 32; 4 quick)")
+    p.add_argument("--reps", type=int, default=None,
+                   help="timed dispatches per config (default 2)")
+    args = p.parse_args()
+
+    import jax
+    from lddl_tpu.models import BertConfig
+    from lddl_tpu.parallel import make_mesh
+
+    device = jax.devices()[0]
+    kind = getattr(device, "device_kind", str(device))
+    peak = PEAK_BF16_TFLOPS.get(kind)
+    peak_flops = peak * 1e12 if peak else None
+    mesh = make_mesh({"dp": 1}, devices=[device])
+
+    n_steps = args.n_steps or (4 if args.quick else 32)
+    reps = args.reps or 2
+
+    if args.quick:
+        shapes = [(4, 64), (4, 128)]
+        base = dict(vocab_size=1024, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128)
+    else:
+        shapes = [(16, 512), (4, 2048)]
+        base = {}
+
+    results = []
+    for batch, seq_len in shapes:
+        for impl in ("dense", "flash"):
+            cfg = BertConfig.bert_base(
+                attention_impl=impl, attention_dropout=0.0,
+                max_position_embeddings=max(512, seq_len), **base)
+            try:
+                row = bench_config(mesh, cfg, batch, seq_len, n_steps, reps,
+                                   peak_flops)
+            except Exception as e:  # e.g. OOM at a large dense shape
+                row = {"attention_impl": impl, "batch": batch,
+                       "seq_len": seq_len,
+                       "error": "{}: {}".format(type(e).__name__,
+                                                str(e)[:300])}
+            print(row, flush=True)
+            results.append(row)
+
+    payload = {
+        "device": str(device),
+        "device_kind": kind,
+        "peak_bf16_tflops": peak,
+        "model": "bert_base (tiny surrogate)" if args.quick else "bert_base",
+        "method": ("each timed dispatch = {} optimizer steps in one jitted "
+                   "lax.scan (make_sharded_multi_step); per-step time = "
+                   "wall / ({}x{}); MFU = matmul-FLOPs / step_time / "
+                   "peak_bf16".format(n_steps, reps, n_steps)),
+        "results": results,
+    }
+    with open(os.path.join(ROOT, "MODEL_BENCH.json"), "w") as f:
+        json.dump(payload, f, indent=1)
+    print("wrote MODEL_BENCH.json")
+
+
+if __name__ == "__main__":
+    main()

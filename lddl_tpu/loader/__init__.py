@@ -2,7 +2,8 @@ from .datasets import ShuffleBuffer, ParquetDataset
 from .dataloader import DataLoader, Binned
 from .bert import get_bert_pretrain_data_loader, BertPretrainBinned
 from .bart import get_bart_pretrain_data_loader, BartCollate
-from .sharding import dp_info_of_process, process_dp_info, to_device_batch
+from .sharding import (dp_info_of_process, process_dp_info, to_device_batch,
+                       to_device_step_batches)
 
 __all__ = [
     "ShuffleBuffer",
@@ -16,4 +17,5 @@ __all__ = [
     "dp_info_of_process",
     "process_dp_info",
     "to_device_batch",
+    "to_device_step_batches",
 ]

@@ -113,3 +113,19 @@ def to_device_batch(batch, mesh):
         out[key] = jax.make_array_from_process_local_data(
             sharding, value, global_shape)
     return out
+
+
+def to_device_step_batches(batches, mesh):
+    """Stacked host-local batches ``{k: [n_steps, local_batch, ...]}`` ->
+    global jax.Arrays for models.make_sharded_multi_step: dim 0 (steps)
+    replicated, dim 1 (batch) sharded over the mesh's data axes. Same
+    per-process contract as to_device_batch, shifted one axis right."""
+    data_axes = mesh_data_axes(mesh)
+    out = {}
+    for key, value in batches.items():
+        value = np.asarray(value)
+        spec = P(None, data_axes if data_axes else None,
+                 *([None] * (value.ndim - 2)))
+        out[key] = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), value, None)
+    return out

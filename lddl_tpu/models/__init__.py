@@ -5,6 +5,7 @@ from .train import (
     TrainState,
     create_train_state,
     make_eval_step,
+    make_sharded_multi_step,
     make_sharded_train_step,
     pretrain_loss,
 )
@@ -21,6 +22,7 @@ __all__ = [
     "TrainState",
     "create_train_state",
     "make_eval_step",
+    "make_sharded_multi_step",
     "make_sharded_train_step",
     "pretrain_loss",
 ]

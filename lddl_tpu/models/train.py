@@ -176,23 +176,18 @@ def bert_batch_loss(outputs, batch, ignore_index=-1):
                          ignore_index=ignore_index)
 
 
-def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
-                            donate=True, batch_loss=None):
-    """A jitted SPMD train step: (state, batch, seed) -> (state, metrics).
-
-    Batch arrays must be globally-sharded jax.Arrays over the mesh's data
-    axes (use lddl_tpu.loader.to_device_batch). Dropout randomness is
-    deterministic per (seed, step). ``batch_loss(outputs, batch)`` ->
-    (loss, metrics) adapts non-BERT models (e.g. models.bart; bind its
-    ignore_index yourself, e.g. functools.partial(bart_batch_loss,
-    ignore_index=...))."""
-    model = model or BertForPreTraining(config)
+def _resolve_batch_loss(batch_loss, ignore_index):
     if batch_loss is not None and ignore_index != -1:
         raise ValueError(
             "ignore_index only configures the default BERT loss; bind it "
             "into your batch_loss instead")
-    batch_loss = batch_loss or functools.partial(bert_batch_loss,
-                                                 ignore_index=ignore_index)
+    return batch_loss or functools.partial(bert_batch_loss,
+                                           ignore_index=ignore_index)
+
+
+def _make_step_fn(model, batch_loss):
+    """The un-jitted SPMD step body shared by the single- and multi-step
+    entry points: (state, batch, seed) -> (state, metrics)."""
 
     def step_fn(state, batch, seed):
         dropout_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
@@ -211,6 +206,23 @@ def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
         new_state = state.apply_gradients(grads)
         return new_state, metrics
 
+    return step_fn
+
+
+def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
+                            donate=True, batch_loss=None):
+    """A jitted SPMD train step: (state, batch, seed) -> (state, metrics).
+
+    Batch arrays must be globally-sharded jax.Arrays over the mesh's data
+    axes (use lddl_tpu.loader.to_device_batch). Dropout randomness is
+    deterministic per (seed, step). ``batch_loss(outputs, batch)`` ->
+    (loss, metrics) adapts non-BERT models (e.g. models.bart; bind its
+    ignore_index yourself, e.g. functools.partial(bart_batch_loss,
+    ignore_index=...))."""
+    model = model or BertForPreTraining(config)
+    step_fn = _make_step_fn(model,
+                            _resolve_batch_loss(batch_loss, ignore_index))
+
     jitted = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
     def wrapped(state, batch, seed=0):
@@ -219,6 +231,41 @@ def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
         with jax.set_mesh(mesh), nn.logical_axis_rules(
                 axis_rules_for(mesh)):
             return jitted(state, batch, seed)
+
+    return wrapped
+
+
+def make_sharded_multi_step(mesh, config, n_steps, model=None,
+                            ignore_index=-1, donate=True, batch_loss=None):
+    """``n_steps`` train steps in ONE dispatch: ``lax.scan`` over the step
+    body — (state, batches, seed) -> (state, stacked metrics).
+
+    The idiomatic TPU training-loop shape: one XLA computation covers many
+    optimizer steps, so per-dispatch host latency (python, runtime RPC —
+    ~100 ms/dispatch on a tunneled chip) is paid once per ``n_steps``
+    instead of per step, and the compiler can overlap step boundaries.
+
+    ``batches`` leaves carry a leading ``[n_steps, ...]`` axis; each scan
+    iteration consumes one slice (use lddl_tpu.loader.to_device_step_batches,
+    or stack one batch n_steps times to re-feed it). Dropout still varies
+    per step: the seed is folded with ``state.step``, which increments
+    inside the scan."""
+    model = model or BertForPreTraining(config)
+    step_fn = _make_step_fn(model,
+                            _resolve_batch_loss(batch_loss, ignore_index))
+
+    def multi_step_fn(state, batches, seed):
+        def body(state, batch):
+            return step_fn(state, batch, seed)
+
+        return jax.lax.scan(body, state, batches, length=n_steps)
+
+    jitted = jax.jit(multi_step_fn, donate_argnums=(0,) if donate else ())
+
+    def wrapped(state, batches, seed=0):
+        with jax.set_mesh(mesh), nn.logical_axis_rules(
+                axis_rules_for(mesh)):
+            return jitted(state, batches, seed)
 
     return wrapped
 

@@ -27,49 +27,137 @@ def get_tokenizer(vocab_file=None, pretrained_model_name=None,
 SPECIAL_TOKENS = ("[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]")
 
 
+def _is_bert_punctuation(c):
+    """BERT's punctuation predicate (category P plus the ASCII symbol
+    ranges), matching the encode-time pre-tokenizer — both the HF
+    BertTokenizerFast and the native engine's tables
+    (native/gen_tables.py) isolate exactly this set."""
+    import unicodedata
+    cp = ord(c)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(c).startswith("P")
+
+
+def _count_word_types(texts, do_lower_case):
+    """Word-type frequencies after BERT-style pre-tokenization (whitespace
+    split + punctuation isolation + lowercase/NFD-strip-accents normalize) —
+    the same word boundary the WordPiece munch sees at encode time."""
+    import unicodedata
+    counter = collections.Counter()
+    for t in texts:
+        if do_lower_case:
+            t = t.lower()
+        t = unicodedata.normalize("NFD", t)
+        t = "".join(c for c in t if unicodedata.category(c) != "Mn")
+        for chunk in t.split():
+            word = []
+            for c in chunk:
+                if _is_bert_punctuation(c):
+                    if word:
+                        counter["".join(word)] += 1
+                        word = []
+                    counter[c] += 1
+                else:
+                    word.append(c)
+            if word:
+                counter["".join(word)] += 1
+    return counter
+
+
 def build_wordpiece_vocab(texts, out_path, vocab_size=30000,
                           do_lower_case=True, min_frequency=1):
     """Train a WordPiece vocab from an iterable of texts; write one token
     per line (BERT vocab format). Returns the path.
 
-    Uses the HF ``tokenizers`` WordPiece trainer when available; falls back
-    to specials + bytes-as-chars + frequent whole words, which is enough for
-    tests and smoke runs.
+    Fully deterministic by construction — unlike the HF ``tokenizers``
+    WordPiece trainer, whose Rust hash-map iteration makes both the id
+    order AND the selected token set vary run to run (observed; it broke
+    byte-reproducibility of every downstream shard). Here: BPE-style
+    greedy pair merging over word types, scored by pair frequency with
+    lexicographic tie-break, alphabet and merges emitted in a canonical
+    order. WordPiece encoding (greedy longest-match) only consumes the
+    token *set*, so canonical ordering is free.
     """
-    texts = list(texts)
-    try:
-        from tokenizers import Tokenizer, models, trainers, normalizers, pre_tokenizers
-        tok = Tokenizer(models.WordPiece(unk_token="[UNK]"))
-        norms = [normalizers.NFD(), normalizers.StripAccents()]
-        if do_lower_case:
-            norms.insert(0, normalizers.Lowercase())
-        tok.normalizer = normalizers.Sequence(norms)
-        tok.pre_tokenizer = pre_tokenizers.BertPreTokenizer()
-        trainer = trainers.WordPieceTrainer(
-            vocab_size=vocab_size,
-            min_frequency=min_frequency,
-            special_tokens=list(SPECIAL_TOKENS),
-            continuing_subword_prefix="##",
-        )
-        tok.train_from_iterator(texts, trainer)
-        vocab = sorted(tok.get_vocab().items(), key=lambda kv: kv[1])
-        tokens = [t for t, _ in vocab]
-    except ImportError:
-        counter = collections.Counter()
-        chars = set()
-        for t in texts:
-            if do_lower_case:
-                t = t.lower()
-            for w in t.split():
-                w = w.strip(".,;:!?\"'()[]")
-                if w:
-                    counter[w] += 1
-                    chars.update(w)
-        tokens = list(SPECIAL_TOKENS)
-        tokens.extend(sorted(chars))
-        tokens.extend(
-            w for w, c in counter.most_common(vocab_size) if c >= min_frequency)
+    import heapq
+
+    counter = _count_word_types(texts, do_lower_case)
+
+    # Word types as symbol sequences: first char bare, continuations "##c".
+    words = []  # [freq, [symbols...]]
+    for word, freq in sorted(counter.items()):
+        words.append([freq, [word[0]] + ["##" + c for c in word[1:]]])
+
+    alphabet = sorted({s for _, syms in words for s in syms})
+    vocab = list(SPECIAL_TOKENS) + alphabet
+    seen = set(vocab)
+
+    # Pair occurrence counts + posting lists (word indices; refreshed
+    # lazily — a stale posting just re-derives the word's current pairs).
+    pair_counts = collections.Counter()
+    postings = collections.defaultdict(set)
+    for wi, (freq, syms) in enumerate(words):
+        for a, b in zip(syms, syms[1:]):
+            pair_counts[(a, b)] += freq
+            postings[(a, b)].add(wi)
+
+    def merged_name(a, b):
+        return a + b[2:] if b.startswith("##") else a + b
+
+    heap = [(-c, p) for p, c in pair_counts.items()]
+    heapq.heapify(heap)
+    while len(vocab) < vocab_size and heap:
+        neg, pair = heapq.heappop(heap)
+        count = pair_counts.get(pair, 0)
+        if count != -neg:  # stale heap entry
+            if count >= min_frequency:
+                heapq.heappush(heap, (-count, pair))
+            continue
+        if count < min_frequency:
+            break
+        new_sym = merged_name(*pair)
+        if new_sym in seen:  # already produced via another merge path
+            del pair_counts[pair]
+            continue
+        vocab.append(new_sym)
+        seen.add(new_sym)
+        a, b = pair
+        touched = set()
+        for wi in postings.pop(pair, ()):
+            freq, syms = words[wi]
+            out = []
+            i = 0
+            while i < len(syms):
+                if i + 1 < len(syms) and syms[i] == a and syms[i + 1] == b:
+                    out.append(new_sym)
+                    i += 2
+                else:
+                    out.append(syms[i])
+                    i += 1
+            if len(out) == len(syms):  # stale posting: pair no longer here
+                continue
+            # Apply the pair-count delta by recount (clearer than in-place
+            # neighborhood surgery, same asymptotics: O(len) per word).
+            for p in zip(syms, syms[1:]):
+                pair_counts[p] -= freq
+                touched.add(p)
+            for p in zip(out, out[1:]):
+                pair_counts[p] += freq
+                touched.add(p)
+                postings[p].add(wi)
+            words[wi][1] = out
+        pair_counts.pop(pair, None)
+        touched.discard(pair)
+        for p in touched:
+            c = pair_counts.get(p, 0)
+            if c >= min_frequency:
+                heapq.heappush(heap, (-c, p))
+            elif c <= 0:
+                pair_counts.pop(p, None)
+                postings.pop(p, None)
+
     with open(out_path, "w", encoding="utf-8") as f:
-        for t in tokens:
+        for t in vocab:
             f.write(t + "\n")
     return out_path

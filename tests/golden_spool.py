@@ -1,0 +1,93 @@
+"""Shared fixture corpus + golden hashes for the shuffle-spool equivalence
+test.
+
+The goldens pin the exact output bytes of the ORIGINAL round-2 spool layout
+(one file per (bucket, block), read back in sorted-filename order —
+runner.py at commit e2b143b). The two-level radix spool that replaced it
+must keep producing byte-identical shards: same seeded permutation, same
+rows, same parquet bytes. Regenerate only if the pipeline's *math* changes
+deliberately: python tests/golden_spool.py <out.json>.
+"""
+
+import glob
+import hashlib
+import json
+import os
+
+import numpy as np
+
+
+def build_corpus(root):
+    """Deterministic 3-file, 60-doc corpus (same generator family as
+    conftest.tiny_corpus but standalone so goldens never depend on test
+    collection order)."""
+    source = os.path.join(root, "source")
+    os.makedirs(source, exist_ok=True)
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+             "lambda mu nu xi omicron pi rho sigma tau upsilon").split()
+    g = np.random.Generator(np.random.Philox(key=[0, 77]))
+    docs = []
+    for d in range(60):
+        sents = []
+        for _ in range(int(g.integers(2, 9))):
+            n_words = int(g.integers(4, 14))
+            picks = [words[int(g.integers(0, len(words)))]
+                     for _ in range(n_words)]
+            sents.append(" ".join(picks).capitalize() + ".")
+        docs.append("doc-{} {}".format(d, " ".join(sents)))
+    for shard in range(3):
+        with open(os.path.join(source, "{}.txt".format(shard)), "w") as f:
+            for line in docs[shard::3]:
+                f.write(line + "\n")
+    return root
+
+
+def build_vocab(root):
+    from lddl_tpu.preprocess import build_wordpiece_vocab
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota kappa "
+             "lambda mu nu xi omicron pi rho sigma tau upsilon").split()
+    path = os.path.join(root, "vocab.txt")
+    return build_wordpiece_vocab([" ".join(words)] * 4, path, vocab_size=200)
+
+
+def run_case(corpus_root, vocab_file, out_dir, binned, **kw):
+    from lddl_tpu.preprocess import (BertPretrainConfig, get_tokenizer,
+                                     run_bert_preprocess)
+    tok = get_tokenizer(vocab_file=vocab_file)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=binned)
+    run_bert_preprocess(
+        {"wikipedia": corpus_root}, out_dir, tok, config=cfg,
+        num_blocks=12, sample_ratio=0.9, seed=4242,
+        bin_size=8 if binned else None, global_shuffle=True, **kw)
+    return hash_outputs(out_dir)
+
+
+def hash_outputs(out_dir):
+    out = {}
+    for path in sorted(glob.glob(os.path.join(out_dir, "part.*"))):
+        with open(path, "rb") as f:
+            out[os.path.basename(path)] = hashlib.sha256(
+                f.read()).hexdigest()
+    return out
+
+
+GOLDEN_FILE = os.path.join(os.path.dirname(__file__), "golden_spool.json")
+
+
+def main(out_json):
+    import tempfile
+    goldens = {}
+    with tempfile.TemporaryDirectory() as td:
+        corpus = build_corpus(os.path.join(td, "corpus"))
+        vocab = build_vocab(td)
+        for name, binned in (("unbinned", False), ("binned_masked", True)):
+            out_dir = os.path.join(td, "out_" + name)
+            goldens[name] = run_case(corpus, vocab, out_dir, binned)
+    with open(out_json, "w") as f:
+        json.dump(goldens, f, indent=1, sort_keys=True)
+    print("wrote", out_json)
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1] if len(sys.argv) > 1 else GOLDEN_FILE)

@@ -79,6 +79,46 @@ def test_train_step_learns(tiny_cfg):
     assert int(state.step) == 60
 
 
+def test_multi_step_matches_single_steps(tiny_cfg):
+    """make_sharded_multi_step(N) over stacked batches is bit-equivalent to
+    N sequential single steps with the same seed (the scanned body folds
+    the seed with state.step exactly like the single-step path)."""
+    from lddl_tpu.loader import to_device_step_batches
+    from lddl_tpu.models import make_sharded_multi_step
+
+    mesh = make_mesh({"dp": 2, "fsdp": 2, "sp": 2})
+    n = 4
+    batches_np = [_fake_batch(tiny_cfg, B=8, L=32, seed=100 + i)
+                  for i in range(n)]
+    opt = make_optimizer(warmup_steps=2, total_steps=20)
+
+    state, _ = create_train_state(tiny_cfg, mesh, batches_np[0],
+                                  optimizer=opt)
+    step = make_sharded_train_step(mesh, tiny_cfg, donate=False)
+    single_losses = []
+    for b in batches_np:
+        state, metrics = step(state, to_device_batch(b, mesh), seed=7)
+        single_losses.append(float(metrics["loss"]))
+    single_params = jax.device_get(state.params)
+
+    state2, _ = create_train_state(tiny_cfg, mesh, batches_np[0],
+                                   optimizer=opt)
+    multi = make_sharded_multi_step(mesh, tiny_cfg, n, donate=False)
+    stacked = to_device_step_batches(
+        {k: np.stack([b[k] for b in batches_np]) for k in batches_np[0]},
+        mesh)
+    state2, metrics = multi(state2, stacked, seed=7)
+    assert int(jax.device_get(state2.step)) == n
+    multi_losses = [float(x) for x in jax.device_get(metrics["loss"])]
+    assert np.allclose(multi_losses, single_losses, rtol=1e-5, atol=1e-6), (
+        multi_losses, single_losses)
+    for a, b in zip(jax.tree.leaves(single_params),
+                    jax.tree.leaves(jax.device_get(state2.params))):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_mesh_portability_same_loss(tiny_cfg):
     """The same seed gives the same initial loss on different meshes —
     sharding must not change the math."""
