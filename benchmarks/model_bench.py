@@ -49,8 +49,9 @@ def matmul_flops_per_step(cfg, batch, seq_len):
         + 2 * h * cfg.vocab_size  # tied MLM decode over all positions
         + 2 * h * h               # MLM transform
     )
-    mult = 4 if cfg.remat else 3  # remat recomputes the forward in bwd
-    return mult * per_token_fwd * batch * seq_len
+    # Always 3x forward: MFU counts MODEL flops, so remat's recompute is
+    # excluded (counting it would be HFU and inflate remat rows by ~33%).
+    return 3 * per_token_fwd * batch * seq_len
 
 
 def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):

@@ -20,11 +20,16 @@ def attach_args(parser=None):
     parser.add_argument("--sample-ratio", type=float, default=0.9)
     parser.add_argument("--seed", type=int, default=12345)
     parser.add_argument("--num-blocks", type=int, default=64)
+    parser.add_argument("--spool-groups", type=int, default=None,
+                        help="coarse radix width of the shuffle spool")
     parser.add_argument("--local-workers", type=int, default=0,
                         help="process-pool size per host "
                              "(0 = one per CPU core)")
     parser.add_argument("--output-format", choices=("parquet", "txt"),
                         default="parquet")
+    attach_bool_arg(parser, "resume", default=False,
+                    help_str="continue a crashed/failed run from its unit "
+                             "ledger (skips completed spool groups)")
     attach_bool_arg(parser, "global-shuffle", default=True)
     return parser
 
@@ -48,6 +53,8 @@ def main(args=None):
         output_format=args.output_format,
         comm=comm,
         log=print,
+        spool_groups=args.spool_groups,
+        resume=args.resume,
     )
 
 

@@ -34,6 +34,10 @@ def attach_args(parser=None):
     parser.add_argument("--seed", type=int, default=12345)
     parser.add_argument("--bin-size", type=int, default=None)
     parser.add_argument("--num-blocks", type=int, default=64)
+    parser.add_argument("--spool-groups", type=int, default=None,
+                        help="coarse radix width of the shuffle spool "
+                             "(default min(blocks, max(64, blocks/8)); "
+                             "spool files = groups x writers)")
     parser.add_argument("--local-workers", type=int, default=0,
                         help="process-pool size per host for bucket "
                              "processing (0 = one per CPU core; the "
@@ -46,6 +50,9 @@ def attach_args(parser=None):
                              "the C++ one-pass kernel)")
     parser.add_argument("--output-format", choices=("parquet", "txt"),
                         default="parquet")
+    attach_bool_arg(parser, "resume", default=False,
+                    help_str="continue a crashed/failed run from its unit "
+                             "ledger (skips completed spool groups)")
     attach_bool_arg(parser, "global-shuffle", default=True,
                     help_str="two-pass global document shuffle")
     return parser
@@ -84,6 +91,8 @@ def main(args=None):
         output_format=args.output_format,
         comm=comm,
         log=print,
+        spool_groups=args.spool_groups,
+        resume=args.resume,
     )
 
 

@@ -103,6 +103,9 @@ def run_bart_preprocess(
     comm=None,
     log=None,
     num_workers=1,
+    spool_groups=None,
+    resume=False,
+    progress_interval=5.0,
 ):
     """Run the BART preprocessing pipeline (SPMD contract per
     run_sharded_pipeline). Output: part.<k>.parquet with a single
@@ -121,4 +124,7 @@ def run_bart_preprocess(
         comm=comm,
         log=log,
         num_workers=num_workers,
+        spool_groups=spool_groups,
+        resume=resume,
+        progress_interval=progress_interval,
     )

@@ -8,6 +8,7 @@ working WordPiece vocab directly from a corpus sample.
 
 import collections
 import os
+import unicodedata
 
 
 def get_tokenizer(vocab_file=None, pretrained_model_name=None,
@@ -32,7 +33,6 @@ def _is_bert_punctuation(c):
     ranges), matching the encode-time pre-tokenizer — both the HF
     BertTokenizerFast and the native engine's tables
     (native/gen_tables.py) isolate exactly this set."""
-    import unicodedata
     cp = ord(c)
     if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
             or 123 <= cp <= 126):
@@ -44,7 +44,6 @@ def _count_word_types(texts, do_lower_case):
     """Word-type frequencies after BERT-style pre-tokenization (whitespace
     split + punctuation isolation + lowercase/NFD-strip-accents normalize) —
     the same word boundary the WordPiece munch sees at encode time."""
-    import unicodedata
     counter = collections.Counter()
     for t in texts:
         if do_lower_case:

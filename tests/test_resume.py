@@ -1,0 +1,167 @@
+"""Preprocess fault tolerance + resume: unit ledger, worker-death retry,
+byte-identical completion (VERDICT r2 #7).
+"""
+
+import json
+import os
+import signal
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu.preprocess.runner import run_sharded_pipeline  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("resume")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    return str(td), corpus, vocab
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    with open(gs.GOLDEN_FILE) as f:
+        return json.load(f)
+
+
+class _FailOnce:
+    """process_bucket wrapper that raises for chosen buckets unless a flag
+    file exists (so the resume run succeeds). Picklable for spawn pools."""
+
+    def __init__(self, inner, fail_buckets, flag_path):
+        self.inner = inner
+        self.fail_buckets = set(fail_buckets)
+        self.flag_path = flag_path
+
+    def __call__(self, texts, bucket):
+        if bucket in self.fail_buckets and not os.path.exists(self.flag_path):
+            raise RuntimeError("injected failure for bucket {}".format(bucket))
+        return self.inner(texts, bucket)
+
+
+class _KillOnce:
+    """SIGKILLs its own worker process for one bucket on the first attempt
+    (flag file marks the kill as spent) — simulates OOM-kill/preemption."""
+
+    def __init__(self, inner, kill_bucket, flag_path):
+        self.inner = inner
+        self.kill_bucket = kill_bucket
+        self.flag_path = flag_path
+
+    def __call__(self, texts, bucket):
+        if bucket == self.kill_bucket and not os.path.exists(self.flag_path):
+            with open(self.flag_path, "w") as f:
+                f.write("killed\n")
+            os.kill(os.getpid(), signal.SIGKILL)
+        return self.inner(texts, bucket)
+
+
+def _bert_processor(vocab, out_dir):
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    from lddl_tpu.preprocess.runner import BertBucketProcessor
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True)
+    return BertBucketProcessor(tok, cfg, 4242, out_dir, 8, "parquet")
+
+
+_RUN_KW = dict(num_blocks=12, sample_ratio=0.9, seed=4242,
+               global_shuffle=True, progress_interval=0.0)
+
+
+def test_failed_unit_is_isolated_then_resumed(fixture_dirs, goldens,
+                                              tmp_path):
+    """A raising unit fails the run AFTER healthy units complete; --resume
+    with the failure cleared redoes only the failed units and the final
+    shards are byte-identical to a clean run (the pinned goldens)."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "fixed.flag")
+    proc = _FailOnce(_bert_processor(vocab, out), [3, 7], flag)
+
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
+    # Healthy units completed and were journaled before the raise.
+    ledgers = os.listdir(os.path.join(out, "_done"))
+    assert len(ledgers) == 12 - 2
+
+    with open(flag, "w") as f:
+        f.write("ok\n")
+    run_sharded_pipeline({"wikipedia": corpus}, out, proc, resume=True,
+                         **_RUN_KW)
+    assert not os.path.isdir(os.path.join(out, "_done"))  # cleaned up
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_worker_sigkill_retried_in_run(fixture_dirs, goldens, tmp_path):
+    """kill -9 of a pool worker mid-run: the pool is rebuilt and the unit
+    retried inside the SAME run; output is byte-identical to the golden."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "killed.flag")
+    proc = _KillOnce(_bert_processor(vocab, out), 5, flag)
+
+    run_sharded_pipeline({"wikipedia": corpus}, out, proc, num_workers=2,
+                         **_RUN_KW)
+    assert os.path.exists(flag)  # the kill really happened
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+class _KillAlwaysUntilFlag(_KillOnce):
+    """Kills the worker on every attempt until the flag file appears."""
+
+    def __call__(self, texts, bucket):
+        if bucket == self.kill_bucket and not os.path.exists(self.flag_path):
+            os.kill(os.getpid(), signal.SIGKILL)
+        return self.inner(texts, bucket)
+
+
+def test_worker_sigkill_exhausted_then_resume(fixture_dirs, goldens,
+                                              tmp_path):
+    """If a unit keeps killing its worker it is marked failed (max
+    attempts), the run raises, and a later resume completes it."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "fixed.flag")
+    proc = _KillAlwaysUntilFlag(_bert_processor(vocab, out), 5, flag)
+
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, num_workers=2,
+                             **_RUN_KW)
+    with open(flag, "w") as f:
+        f.write("ok\n")
+    run_sharded_pipeline({"wikipedia": corpus}, out, proc, num_workers=2,
+                         resume=True, **_RUN_KW)
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_resume_with_incomplete_scatter_redoes_scatter(fixture_dirs, goldens,
+                                                       tmp_path):
+    """A run killed during scatter leaves no completion marker; resume must
+    wipe the partial spool, redo the scatter, and still match the golden."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _bert_processor(vocab, out)
+
+    # Simulate a dead run: half-written spool, no marker, no ledger.
+    spool = os.path.join(out, "_shuffle", "group-0")
+    os.makedirs(spool)
+    with open(os.path.join(spool, "w0-999.txt"), "w") as f:
+        f.write("0 0 doc-torn torn line from a dead writer\n")
+
+    run_sharded_pipeline({"wikipedia": corpus}, out, proc, resume=True,
+                         **_RUN_KW)
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_fresh_dir_refuses_without_resume(fixture_dirs, tmp_path):
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _bert_processor(vocab, out)
+    run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
+    with pytest.raises(ValueError, match="resume"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
