@@ -1,6 +1,7 @@
 from .datasets import ShuffleBuffer, ParquetDataset
 from .dataloader import DataLoader, Binned
-from .bert import get_bert_pretrain_data_loader, BertPretrainBinned
+from .bert import (get_bert_pretrain_data_loader, BertPretrainBinned,
+                   BertPackedCollate, PackedBertLoader)
 from .bart import get_bart_pretrain_data_loader, BartCollate
 from .sharding import (dp_info_of_process, process_dp_info, to_device_batch,
                        to_device_step_batches)
@@ -14,6 +15,8 @@ __all__ = [
     "get_bart_pretrain_data_loader",
     "BartCollate",
     "BertPretrainBinned",
+    "BertPackedCollate",
+    "PackedBertLoader",
     "dp_info_of_process",
     "process_dp_info",
     "to_device_batch",

@@ -190,6 +190,208 @@ class BertCollate:
         return out, labels
 
 
+class BertPackedCollate(BertCollate):
+    """samples + packed layout -> encoded packed batch (sequence packing,
+    ops/packing.py): several samples per fixed-length row, block-diagonal
+    attention via per-token ``segments``, per-sample ``position_ids``
+    restart, per-sample [CLS] columns in ``cls_positions`` and NSP labels
+    [R, P] padded with ignore_index. Rows are always exactly
+    ``pack_seq_length`` wide — ONE static shape for the whole run."""
+
+    def __init__(self, tokenizer, pack_seq_length, pack_rows, pack_max_per_row,
+                 ignore_index=-1, mlm_prob=0.15, emit_loss_mask=False):
+        super().__init__(tokenizer, fixed_seq_length=pack_seq_length,
+                         ignore_index=ignore_index, mlm_prob=mlm_prob,
+                         emit_loss_mask=emit_loss_mask)
+        self._rows = pack_rows
+        self._max_per_row = pack_max_per_row
+
+    def __call__(self, layout_rows, samples, g=None):
+        from ..ops.packing import packed_layout_arrays
+        L, R, P = self._fixed_seq_length, self._rows, self._max_per_row
+        n = len(samples)
+        static = len(samples[0]) == 5
+        layout = packed_layout_arrays(layout_rows, L, P)
+        if layout["n_rows"] > R or n != len(layout["row_of"]):
+            raise ValueError("layout/sample mismatch: {} rows > {} or "
+                             "{} != {}".format(layout["n_rows"], R, n,
+                                               len(layout["row_of"])))
+
+        flat_a, lens_a = self._token_ids_and_lens([s[0] for s in samples])
+        flat_b, lens_b = self._token_ids_and_lens([s[1] for s in samples])
+        totals = lens_a + lens_b + 3
+        row_of, offset_of = layout["row_of"], layout["offset_of"]
+        slot_of = layout["slot_of"]
+
+        base = row_of * L + offset_of                   # flat start per sample
+        idx_a = np.repeat(base + 1, lens_a) + self._concat_aranges(lens_a)
+        idx_b = (np.repeat(base + 2 + lens_a, lens_b)
+                 + self._concat_aranges(lens_b))
+        idx_all = np.repeat(base, totals) + self._concat_aranges(totals)
+
+        input_ids = np.zeros((R, L), dtype=np.int32)
+        input_ids.flat[idx_a] = flat_a
+        input_ids.flat[idx_b] = flat_b
+        input_ids.flat[base] = self._cls_id
+        input_ids.flat[base + 1 + lens_a] = self._sep_id
+        input_ids.flat[base + totals - 1] = self._sep_id
+
+        token_type_ids = np.zeros((R, L), dtype=np.int32)
+        # type 1 spans B plus its trailing [SEP], like the unpacked collate.
+        idx_b_ext = (np.repeat(base + 2 + lens_a, lens_b + 1)
+                     + self._concat_aranges(lens_b + 1))
+        token_type_ids.flat[idx_b_ext] = 1
+
+        attention_mask = np.zeros((R, L), dtype=np.int32)
+        attention_mask.flat[idx_all] = 1
+        segments = np.zeros((R, L), dtype=np.int32)
+        segments.flat[idx_all] = np.repeat(slot_of + 1, totals)
+        position_ids = np.zeros((R, L), dtype=np.int32)
+        position_ids.flat[idx_all] = self._concat_aranges(totals)
+
+        cls_positions = np.zeros((R, P), dtype=np.int32)
+        nsp = np.full((R, P), self._ignore_index, dtype=np.int32)
+        cls_positions[row_of, slot_of] = offset_of
+        nsp[row_of, slot_of] = np.asarray([int(s[2]) for s in samples],
+                                          dtype=np.int32)
+
+        labels = np.full((R, L), self._ignore_index, dtype=np.int32)
+        if static:
+            pos_list = [deserialize_np_array(s[3]).astype(np.int64)
+                        for s in samples]
+            flat_labels, lens_m = self._token_ids_and_lens(
+                [s[4] for s in samples])
+            labels.flat[np.repeat(base, lens_m)
+                        + np.concatenate(pos_list)] = flat_labels
+        else:
+            if g is None:
+                raise ValueError("dynamic masking needs a worker RNG")
+            special = np.ones((R, L), dtype=bool)
+            special.flat[idx_a] = False
+            special.flat[idx_b] = False
+            input_ids, labels = self._mask_tokens(input_ids, special, g)
+
+        batch = {
+            "input_ids": input_ids,
+            "token_type_ids": token_type_ids,
+            "attention_mask": attention_mask,
+            "segments": segments,
+            "position_ids": position_ids,
+            "cls_positions": cls_positions,
+            "next_sentence_labels": nsp,
+            "labels": labels,
+        }
+        if self._emit_loss_mask:
+            batch["loss_mask"] = (labels != self._ignore_index).astype(
+                np.int32)
+        stats = {"pad_tokens": int(layout["pad_tokens"]
+                                   + (R - layout["n_rows"]) * L),
+                 "total_tokens": R * L, "n_samples": n}
+        return batch, stats
+
+
+class PackedBertLoader:
+    """Streams raw samples from an inner DataLoader through a
+    StreamPacker, emitting packed batches of exactly ``pack_rows`` x
+    ``pack_seq_length``. Packing is deterministic (first-fit in stream
+    order) and carries leftover samples across batch boundaries, so no
+    sample is dropped; the final partial batch pads with empty rows."""
+
+    _PACK_RNG_TAG = 0xACED  # dynamic-masking stream domain for packed mode
+
+    def __init__(self, inner, collate, pack_seq_length, pack_rows,
+                 pack_max_per_row, pack_horizon=None):
+        from ..ops.packing import StreamPacker
+        self._inner = inner
+        self._collate = collate
+        self._L = pack_seq_length
+        self._R = pack_rows
+        self._P = pack_max_per_row
+        self._horizon = pack_horizon
+        self._StreamPacker = StreamPacker
+        # Cumulative packing efficiency (reset each epoch): pad_ratio =
+        # pad_tokens / total_tokens over the emitted batches.
+        self.pad_tokens = 0
+        self.total_tokens = 0
+        self.n_samples = 0
+
+    @property
+    def pad_ratio(self):
+        return self.pad_tokens / max(self.total_tokens, 1)
+
+    # Collate encodes run on a small thread pool (numpy scatter work,
+    # largely GIL-releasing): layout assignment stays serial-deterministic,
+    # encode order is preserved by yielding futures FIFO.
+    _COLLATE_THREADS = 2
+
+    def __iter__(self):
+        import collections
+        import concurrent.futures as cf
+
+        from ..utils import rng as lrng
+        ds = self._inner.dataset
+        inner_it = iter(self._inner)   # advances the epoch
+        packer = self._StreamPacker(self._L, self._R, self._P,
+                                    horizon=self._horizon)
+        store = {}                     # global ordinal -> sample
+        self.pad_tokens = self.total_tokens = self.n_samples = 0
+        pool = cf.ThreadPoolExecutor(max_workers=self._COLLATE_THREADS)
+        inflight = collections.deque()
+        batch_idx = 0
+
+        def submit(rows):
+            nonlocal batch_idx
+            if not rows:
+                return
+            # Relabel global ordinals to batch-local 0..n-1 (collate
+            # contract) in stream order, and pull their samples.
+            ordinals = sorted(o for row in rows for o, _ in row)
+            local = {o: i for i, o in enumerate(ordinals)}
+            rows_local = [[(local[o], length) for o, length in row]
+                          for row in rows]
+            samples = [store.pop(o) for o in ordinals]
+            # Per-BATCH masking stream (not one shared generator): collates
+            # run concurrently on the pool, and interleaved draws from a
+            # shared stream would be schedule-dependent.
+            g = lrng.sample_rng(ds.base_seed, self._PACK_RNG_TAG, ds.epoch,
+                                ds.dp_rank, batch_idx)
+            batch_idx += 1
+            inflight.append(pool.submit(self._collate, rows_local, samples,
+                                        g=g))
+
+        def drain(block):
+            while inflight and (block
+                                or len(inflight) > self._COLLATE_THREADS):
+                batch, stats = inflight.popleft().result()
+                self.pad_tokens += stats["pad_tokens"]
+                self.total_tokens += stats["total_tokens"]
+                self.n_samples += stats["n_samples"]
+                yield batch
+
+        def sample_len(s):
+            return len(s[0].split()) + len(s[1].split()) + 3
+
+        try:
+            for raw_batch in inner_it:
+                # (ds.epoch advanced at the iterator's first yield, before
+                # any submit can run)
+                for sample in raw_batch:
+                    length = sample_len(sample)
+                    ordinal = packer.add(length)
+                    if ordinal is None:
+                        submit(packer.emit_fullest())
+                        yield from drain(block=False)
+                        ordinal = packer.add(length)
+                        assert ordinal is not None
+                    store[ordinal] = sample
+            while packer.open_rows:
+                submit(packer.emit_fullest())
+            yield from drain(block=True)
+            assert not store
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)
+
+
 class BertPretrainBinned(Binned):
 
     def _get_batch_size(self, batch):
@@ -222,6 +424,11 @@ def get_bert_pretrain_data_loader(
     return_raw_samples=False,
     prefetch=2,
     comm=None,
+    pack_seq_length=None,
+    pack_rows=None,
+    pack_max_per_row=8,
+    pack_horizon=None,
+    pack_allow_uneven_epochs=False,
 ):
     """Build the BERT pretraining loader over balanced shards at ``path``.
 
@@ -230,6 +437,13 @@ def get_bert_pretrain_data_loader(
     (ref: lddl/torch/bert.py:199-413). For TPU static shapes pass
     ``fixed_seq_lengths``: an int (unbinned) or a list with one padded
     length per bin.
+
+    Sequence packing (``pack_seq_length`` + ``pack_rows``): several short
+    samples share each fixed-length row with block-diagonal attention —
+    batches gain ``segments``/``position_ids``/``cls_positions`` keys, NSP
+    labels become [rows, pack_max_per_row], and the consumer is
+    models.BertForPreTrainingPacked. Packing subsumes binning (every row
+    is exactly pack_seq_length wide), so it requires unbinned shards.
 
     ``dp_rank``/``num_dp_groups`` name the data-parallel group of this
     process — derive them from a device mesh with
@@ -250,6 +464,32 @@ def get_bert_pretrain_data_loader(
     if not file_paths:
         raise ValueError("no parquet shards under {}".format(path))
     bin_ids = get_all_bin_ids(file_paths)
+
+    packing = pack_seq_length is not None or pack_rows is not None
+    if packing:
+        if pack_seq_length is None or pack_rows is None:
+            raise ValueError("packing needs BOTH pack_seq_length and "
+                             "pack_rows")
+        if num_dp_groups > 1 and not pack_allow_uneven_epochs:
+            # Packed batch boundaries depend on each group's length mix,
+            # so per-epoch batch COUNTS can differ by a few across dp
+            # groups — a lockstep loop would deadlock in a collective at
+            # the shortest group's end. Until a synchronized packed epoch
+            # exists, the caller must bound steps itself (e.g.
+            # itertools.islice to an allreduce-min of batch counts) and
+            # acknowledge that with the override flag.
+            raise ValueError(
+                "sequence packing with num_dp_groups > 1 yields uneven "
+                "per-group batch counts; pass "
+                "pack_allow_uneven_epochs=True and bound your step loop "
+                "(e.g. islice to the min batch count across groups)")
+        if bin_ids:
+            raise ValueError(
+                "packing requires unbinned shards (rows are always exactly "
+                "pack_seq_length wide, which subsumes binning); preprocess "
+                "without --bin-size")
+        if return_raw_samples:
+            raise ValueError("return_raw_samples and packing are exclusive")
 
     def make_dataset(paths, transform=None):
         return ParquetDataset(
@@ -299,6 +539,17 @@ def get_bert_pretrain_data_loader(
                                   base_seed=base_seed,
                                   start_epoch=start_epoch,
                                   logger=logger)
+    if packing:
+        inner = DataLoader(make_dataset(file_paths), batch_size,
+                           collate_fn=None, prefetch=prefetch)
+        return PackedBertLoader(
+            inner,
+            BertPackedCollate(tokenizer, pack_seq_length, pack_rows,
+                              pack_max_per_row, ignore_index=ignore_index,
+                              mlm_prob=mlm_prob,
+                              emit_loss_mask=emit_loss_mask),
+            pack_seq_length, pack_rows, pack_max_per_row,
+            pack_horizon=pack_horizon)
     fixed = fixed_seq_lengths
     if isinstance(fixed, (list, tuple)):
         if len(fixed) != 1:

@@ -1,5 +1,6 @@
 from .bart import BartConfig, BartForPreTraining, bart_batch_loss
-from .bert import BertConfig, BertForPreTraining
+from .bert import (BertConfig, BertForPreTraining,
+                   BertForPreTrainingPacked)
 from .checkpoint import latest_step, restore_train_state, save_train_state
 from .train import (
     TrainState,
@@ -16,6 +17,7 @@ __all__ = [
     "bart_batch_loss",
     "BertConfig",
     "BertForPreTraining",
+    "BertForPreTrainingPacked",
     "latest_step",
     "restore_train_state",
     "save_train_state",

@@ -38,7 +38,8 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, q_input, kv_input, padding_mask, deterministic,
-                 extra_bias: Optional[Any] = None):
+                 extra_bias: Optional[Any] = None,
+                 segments: Optional[Any] = None):
         head_dim = self.hidden_size // self.num_heads
         init = nn.initializers.normal(stddev=self.initializer_range)
 
@@ -66,6 +67,13 @@ class MultiHeadAttention(nn.Module):
             from jax.sharding import get_abstract_mesh
             mesh = get_abstract_mesh()
             use_ring = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+        if segments is not None and use_ring:
+            # Packing serves SHORT samples; ring serves LONG sequences —
+            # the combination has no use case, so fail loudly rather than
+            # silently attending across packed samples.
+            raise NotImplementedError(
+                "packed sequences (segments) are not supported with ring "
+                "attention; use attention_impl='flash' or 'dense'")
 
         if use_ring:
             # Sequence stays sharded: Q/K/V keep the "seq" axis on sp and
@@ -79,13 +87,18 @@ class MultiHeadAttention(nn.Module):
             ctx = ring_attention(q, k, v, padding_mask, mesh)
         elif self.attention_impl == "flash" and blockwise_ok:
             # The pallas fused kernel (ops/flash_attention.py); attention-
-            # prob dropout is skipped, like ring.
+            # prob dropout is skipped, like ring. Packed rows hand the
+            # kernel per-token segment ids — the block-diagonal mask is
+            # enforced inside the kernel, no L x L mask materializes.
             from ..ops.flash_attention import flash_attention
 
             q = split_heads(proj("query")(q_input), None)
             k = split_heads(proj("key")(kv_input), None)
             v = split_heads(proj("value")(kv_input), None)
-            ctx = flash_attention(q, k, v, padding_mask)
+            if segments is not None:
+                ctx = flash_attention(q, k, v, segments, q_mask=segments)
+            else:
+                ctx = flash_attention(q, k, v, padding_mask)
         else:
             # Full-sequence attention: entering this block the activations
             # all-gather from sp, and heads shard over tp.
@@ -98,7 +111,14 @@ class MultiHeadAttention(nn.Module):
             # Finite large-negative (not dtype-min): fp32 min overflows to
             # -inf in bf16, and an all-masked row would softmax to NaN.
             bias = 0.0
-            if padding_mask is not None:
+            if segments is not None:
+                # Packed rows: block-diagonal — attend only same-segment,
+                # non-pad keys (subsumes the padding mask).
+                allowed = ((segments[:, None, :, None]
+                            == segments[:, None, None, :])
+                           & (segments[:, None, None, :] > 0))
+                bias = jnp.where(allowed, 0.0, -1e9)
+            elif padding_mask is not None:
                 bias = jnp.where(padding_mask[:, None, None, :] > 0, 0.0,
                                  -1e9)
             if extra_bias is not None:

@@ -127,14 +127,16 @@ class Embeddings(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, input_ids, token_type_ids, deterministic):
+    def __call__(self, input_ids, token_type_ids, deterministic,
+                 position_ids=None):
         cfg = self.cfg
         word = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
                 _dense_init(cfg), ("vocab", "embed")),
             name="word_embeddings")(input_ids)
-        position_ids = jnp.arange(input_ids.shape[1])[None, :]
+        if position_ids is None:
+            position_ids = jnp.arange(input_ids.shape[1])[None, :]
         pos = nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
@@ -182,10 +184,11 @@ class EncoderLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, attention_mask, deterministic):
+    def __call__(self, x, attention_mask, deterministic, segments=None):
         cfg = self.cfg
         attn = _attention(cfg, "attention")(x, x, attention_mask,
-                                            deterministic)
+                                            deterministic,
+                                            segments=segments)
         attn = nn.Dropout(cfg.hidden_dropout)(attn, deterministic=deterministic)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="attention_norm")(x + attn)
@@ -200,21 +203,31 @@ class EncoderLayer(nn.Module):
 class BertForPreTraining(nn.Module):
     """Encoder + MLM head + NSP head.
 
-    Returns (mlm_logits [B,L,vocab], nsp_logits [B,2]) in fp32.
+    Unpacked: returns (mlm_logits [B,L,vocab], nsp_logits [B,2]) in fp32.
+
+    Packed rows (sequence packing, ops/packing.py): pass ``segments``
+    [B,L] (per-token pack slot id, 0 = pad — attention becomes
+    block-diagonal), ``position_ids`` [B,L] (restart at each packed
+    sample, so every sample sees the same positions it would unpacked)
+    and ``cls_positions`` [B,P] (each packed sample's [CLS] column);
+    nsp_logits is then [B,P,2]. Params are identical either way — the
+    same checkpoint serves packed and unpacked training.
     """
     cfg: BertConfig
 
     @nn.compact
     def __call__(self, input_ids, token_type_ids, attention_mask,
+                 segments=None, position_ids=None, cls_positions=None,
                  deterministic=True):
         cfg = self.cfg
         x = Embeddings(cfg, name="embeddings")(
-            input_ids, token_type_ids, deterministic)
+            input_ids, token_type_ids, deterministic,
+            position_ids=position_ids)
         layer_cls = (nn.remat(EncoderLayer, static_argnums=(3,))
                      if cfg.remat else EncoderLayer)
         for i in range(cfg.num_layers):
             x = layer_cls(cfg, name="layer_{}".format(i))(
-                x, attention_mask, deterministic)
+                x, attention_mask, deterministic, segments)
 
         # MLM head: transform + tied-free decoder to vocab (column-parallel).
         h = nn.Dense(
@@ -233,16 +246,30 @@ class BertForPreTraining(nn.Module):
                 nn.initializers.zeros_init(), ("vocab",)),
             name="mlm_decoder")(h)
 
-        # NSP head over the [CLS] position.
+        # NSP head over the [CLS] position(s): [B,0] unpacked, or every
+        # packed sample's own [CLS] column.
+        if cls_positions is None:
+            cls_states = x[:, 0]                       # [B, H]
+        else:
+            cls_states = jnp.take_along_axis(           # [B, P, H]
+                x, cls_positions[:, :, None], axis=1)
         pooled = nn.tanh(
             nn.Dense(
                 cfg.hidden_size, dtype=cfg.dtype,
                 kernel_init=nn.with_logical_partitioning(
                     _dense_init(cfg), ("embed", "embed_out")),
-                name="pooler")(x[:, 0]))
+                name="pooler")(cls_states))
         nsp_logits = nn.Dense(
             2, dtype=jnp.float32,
             kernel_init=nn.with_logical_partitioning(
                 _dense_init(cfg), ("embed", None)),
             name="nsp_classifier")(pooled)
         return mlm_logits, nsp_logits
+
+
+class BertForPreTrainingPacked(BertForPreTraining):
+    """BertForPreTraining bound to the packed-batch key order (same params;
+    see the base class docstring and loader/bert.py packed collate)."""
+
+    BATCH_INPUTS = ("input_ids", "token_type_ids", "attention_mask",
+                    "segments", "position_ids", "cls_positions")

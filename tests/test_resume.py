@@ -86,7 +86,8 @@ def test_failed_unit_is_isolated_then_resumed(fixture_dirs, goldens,
     with pytest.raises(RuntimeError, match="re-run with resume"):
         run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
     # Healthy units completed and were journaled before the raise.
-    ledgers = os.listdir(os.path.join(out, "_done"))
+    ledgers = [n for n in os.listdir(os.path.join(out, "_done"))
+               if n.startswith("group-")]
     assert len(ledgers) == 12 - 2
 
     with open(flag, "w") as f:
@@ -156,6 +157,24 @@ def test_resume_with_incomplete_scatter_redoes_scatter(fixture_dirs, goldens,
     run_sharded_pipeline({"wikipedia": corpus}, out, proc, resume=True,
                          **_RUN_KW)
     assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_resume_refuses_mismatched_arguments(fixture_dirs, tmp_path):
+    """Resuming with a different unit plan (num_blocks/spool_groups/seed)
+    must refuse loudly: ledger ids would denote different bucket sets."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "never.flag")
+    proc = _FailOnce(_bert_processor(vocab, out), [3], flag)
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
+    bad = dict(_RUN_KW, num_blocks=24)
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, resume=True,
+                             **bad)
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, resume=True,
+                             **dict(_RUN_KW, seed=999))
 
 
 def test_fresh_dir_refuses_without_resume(fixture_dirs, tmp_path):
