@@ -202,11 +202,14 @@ def main():
         "sentences": {"ours": n_sent_ours, "punkt": n_sent_punkt},
         "seq_len_hist_total_variation": round(tv, 4),
         "punkt_only_breakdown": dict(miss_categories),
-        "note": ("self-trained punkt is a noisy oracle (it has no "
-                 "pretrained abbreviation list); next-punctuation misses "
-                 "are bullet-list boundaries and next-lowercase misses "
-                 "are identifier/abbreviation starts, both deliberate "
-                 "rule differences — see benchmarks/splitter_drift.py")
+        "note": ("self-trained punkt is a noisy oracle (no pretrained "
+                 "abbreviation list; the pretrained English model needs "
+                 "egress this image does not have). Round-3 rules: split "
+                 "before anything but a lowercase start (lowercase only "
+                 "after !/?), punkt-style enumerator attachment; residual "
+                 "misses are lowercase identifier starts in API docs "
+                 "(deliberate) and punkt's own inconsistent enumerator "
+                 "choices — see benchmarks/splitter_drift.py")
                 if punkt_src == "self-trained" else
                 "measured against the reference's pretrained English punkt",
     }

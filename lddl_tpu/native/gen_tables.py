@@ -29,8 +29,11 @@ F_HF_CTRL = 8     # HF is_control: category C*, except \t\n\r
 F_HF_PUNCT = 16   # HF is_punctuation: ASCII punct ranges or category P*
 F_CJK = 32        # HF chinese-char ranges (BMP part)
 F_ALPHA = 64      # str.isalpha()
+F_LOWER = 128     # str.islower() (single char)
+F_RE_DIGIT = 256  # Python re \d (str patterns) == category Nd
 
 _RE_SPACE = re.compile(r"\s")
+_RE_DIGIT = re.compile(r"\d")
 
 # HF is_chinese_char ranges (BMP + astral extension blocks).
 _CJK = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0xF900, 0xFAFF),
@@ -69,6 +72,10 @@ def _flags(cp):
         f |= F_CJK
     if c.isalpha():
         f |= F_ALPHA
+    if c.islower():
+        f |= F_LOWER
+    if _RE_DIGIT.match(c):
+        f |= F_RE_DIGIT
     return f
 
 
@@ -293,13 +300,15 @@ def generate(out_path):
         "#define F_HF_PUNCT {}".format(F_HF_PUNCT),
         "#define F_CJK {}".format(F_CJK),
         "#define F_ALPHA {}".format(F_ALPHA),
-        dump("UFLAGS", "uint8_t", flags),
+        "#define F_LOWER {}".format(F_LOWER),
+        "#define F_RE_DIGIT {}".format(F_RE_DIGIT),
+        dump("UFLAGS", "uint16_t", flags),
         dump("FOLD_IDX", "uint16_t", fold_idx),
         dump("FOLD_N", "uint8_t", [e[0] for e in entries]),
         dump("FOLD_OUT", "uint32_t",
              [v for e in entries for v in (e[1], e[2], e[3])]),
         dump("AFLAG_START", "uint32_t", astral_starts),
-        dump("AFLAG_VALUE", "uint8_t", astral_flags),
+        dump("AFLAG_VALUE", "uint16_t", astral_flags),
         dump("AFOLD_CP", "uint32_t", [e[0] for e in astral_folds]),
         dump("AFOLD_N", "uint8_t", [e[1] for e in astral_folds]),
         dump("AFOLD_OUT", "uint32_t",

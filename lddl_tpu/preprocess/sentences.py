@@ -25,10 +25,12 @@ _ABBREVIATIONS = frozenset(
         "Inc Ltd Corp Co Dept Univ Assn Bros "
         "a.m p.m U.S U.K U.N E.U Ph.D M.D B.A M.A D.C").split())
 
-# A sentence boundary: terminator + optional closing quotes/brackets
-# (group 1), whitespace, then a plausible sentence start.
-_BOUNDARY = re.compile(
-    r"([.!?][\"'\)\]”’]*)\s+(?=[\"'\(\[“‘]?[A-Z0-9])")
+# A boundary candidate: terminator + optional closing quotes/brackets
+# (group 1), whitespace, then anything. What may FOLLOW a boundary is
+# decided in code (see split_sentences): everything except a lowercase
+# letter — matching punkt, which splits before bullets/quotes/digits —
+# and lowercase too when the terminator is ! or ? (unambiguous enders).
+_BOUNDARY = re.compile(r"([.!?][\"'\)\]”’]*)\s+(?=\S)")
 
 
 def _use_nltk():
@@ -63,13 +65,15 @@ def _looks_like_abbreviation(left):
         return True
     if re.fullmatch(r"(?:[A-Za-z]\.)+[A-Za-z]?", core):
         return True
-    # Bare list enumerator opening the piece ("2. Grant of License."):
-    # glue it to the sentence it numbers. <= 3 digits so a sentence
-    # starting with a bare year still splits.
-    if (core.isdigit() and len(core) <= 3 and core.isascii()
-            and left.strip() == word):
-        return True
     return core.lower() in _ABBREVIATIONS
+
+
+# A bare list enumerator right after a boundary ("License. 2. Grant ..."):
+# punkt glues it to the PRECEDING sentence ("... License. 2.") and splits
+# after it, so we suppress the boundary before it and let the enumerator's
+# own dot provide the boundary. <= 3 digits so a bare year still starts a
+# sentence.
+_ENUMERATOR_NEXT = re.compile(r"\d{1,3}\.[\"'\)\]”’]*\s")
 
 
 def split_sentences(text):
@@ -84,8 +88,18 @@ def split_sentences(text):
     sentences = []
     start = 0
     for m in _BOUNDARY.finditer(text):
+        terminator = text[m.start(1)]
+        nxt = text[m.end()]
+        # A sentence may start with anything but a lowercase letter
+        # (bullets, quotes, digits, uppercase); lowercase continuations
+        # only split after the unambiguous enders ! and ?.
+        if nxt.islower() and terminator == ".":
+            continue
+        if _ENUMERATOR_NEXT.match(text, m.end()):
+            continue
         # Left context up to and including the terminator character.
-        if _looks_like_abbreviation(text[start:m.start(1) + 1]):
+        if terminator == "." and _looks_like_abbreviation(
+                text[start:m.start(1) + 1]):
             continue
         piece = text[start:m.end(1)].strip()
         if piece:

@@ -241,9 +241,13 @@ def test_packed_loader_e2e_and_train_step(packed_setup, tmp_path):
     assert float(metrics["nsp_accuracy"]) <= 1.0
 
 
-def test_packed_deterministic_across_workers(packed_setup, tmp_path):
-    """Worker count must not change packed batches (stream order is
-    worker-round-robin deterministic)."""
+def test_packed_reproducible_at_fixed_worker_count(packed_setup, tmp_path):
+    """Packed batches are a pure function of (seed, epoch, worker count):
+    re-running with the same config is bit-identical, including the
+    threaded collate (per-batch RNG streams). Worker count DOES change the
+    sample stream order (round-robin service), same as the unpacked
+    loader and the reference's DataLoader workers — that is config, not
+    nondeterminism."""
     from lddl_tpu.loader import get_bert_pretrain_data_loader
 
     words, vocab_file, tok = packed_setup
@@ -255,7 +259,9 @@ def test_packed_deterministic_across_workers(packed_setup, tmp_path):
             shuffle_buffer_size=64, pack_seq_length=128, pack_rows=8)
         return list(loader)
 
-    b1, b2 = run(1), run(1)
-    for x, y in zip(b1, b2):
-        for key in x:
-            np.testing.assert_array_equal(x[key], y[key])
+    for workers in (1, 2):
+        b1, b2 = run(workers), run(workers)
+        assert len(b1) == len(b2)
+        for x, y in zip(b1, b2):
+            for key in x:
+                np.testing.assert_array_equal(x[key], y[key])

@@ -68,6 +68,34 @@ def test_split_sentences_initials_and_decimals():
     assert any("3.14" in x for x in s)
 
 
+def test_split_sentences_non_upper_starts():
+    """Round-3 rules: a sentence may start with bullets/quotes/dashes —
+    anything but a lowercase letter (punkt behavior)."""
+    s = split_sentences('He agreed. "Fine," she said. - item one follows.')
+    assert s[0] == "He agreed."
+    s = split_sentences("Conditions are met: * Redistributions must keep "
+                        "the notice. * Binaries too.")
+    assert len(s) == 2
+
+
+def test_split_sentences_lowercase_after_bang_only():
+    s = split_sentences("What a day! so we left. but we did not return.")
+    assert s[0] == "What a day!"          # lowercase start after ! splits
+    assert len(s) == 2                    # '.' + lowercase does not
+
+
+def test_split_sentences_enumerator_attachment():
+    """Bare enumerators glue to the PRECEDING sentence, punkt-style, and
+    their own dot provides the boundary."""
+    s = split_sentences("See the License. 2. Grant of Patent License. "
+                        "Subject to terms.")
+    assert s[0] == "See the License. 2."
+    assert s[1] == "Grant of Patent License."
+    # A bare year still starts its own sentence.
+    s = split_sentences("It happened. 1991 was the year it began.")
+    assert s == ["It happened.", "1991 was the year it began."]
+
+
 def test_plan_blocks_and_read(tiny_corpus):
     from lddl_tpu.preprocess.readers import discover_source_files
     files = discover_source_files({"wikipedia": tiny_corpus})
