@@ -140,6 +140,12 @@ def main():
                                  ["--num-workers", "1"]),
             "static_binned_w4": (datasets["static_binned"],
                                  ["--num-workers", "4"]),
+            "dynamic_unbinned_w4proc": (
+                datasets["dynamic_unbinned"],
+                ["--num-workers", "4", "--worker-mode", "process"]),
+            "static_binned_w4proc": (
+                datasets["static_binned"],
+                ["--num-workers", "4", "--worker-mode", "process"]),
         }
         if args.with_model:
             configs["static_binned_w4_model"] = (

@@ -73,6 +73,8 @@ def attach_args():
     p.add_argument("--epochs", type=int, default=2)
     p.add_argument("--start-epoch", type=int, default=0)
     p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--worker-mode", choices=("thread", "process"),
+                   default="thread")
     p.add_argument("--log-freq", type=int, default=100)
     p.add_argument("--seed", type=int, default=12345)
     p.add_argument("--dp-rank", type=int, default=0)
@@ -136,6 +138,7 @@ def main():
             num_dp_groups=args.num_dp_groups,
             batch_size=args.batch_size,
             num_workers=args.num_workers,
+            worker_mode=args.worker_mode,
             vocab_file=args.vocab_file,
             max_seq_length=fixed or 128,
             fixed_seq_length=fixed,
@@ -150,6 +153,7 @@ def main():
             num_dp_groups=args.num_dp_groups,
             batch_size=args.batch_size,
             num_workers=args.num_workers,
+            worker_mode=args.worker_mode,
             vocab_file=args.vocab_file,
             fixed_seq_lengths=args.fixed_seq_lengths,
             base_seed=args.seed,

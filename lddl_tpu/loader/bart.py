@@ -199,6 +199,7 @@ def get_bart_pretrain_data_loader(
     return_raw_samples=False,
     prefetch=2,
     comm=None,
+    worker_mode="thread",
 ):
     """BART denoising loader over ``{sentences}`` shards at ``path``."""
     import logging
@@ -238,4 +239,4 @@ def get_bart_pretrain_data_loader(
         ignore_index=ignore_index,
     )
     return DataLoader(dataset, batch_size, collate_fn=collate,
-                      prefetch=prefetch)
+                      prefetch=prefetch, worker_mode=worker_mode)

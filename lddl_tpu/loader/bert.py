@@ -429,6 +429,7 @@ def get_bert_pretrain_data_loader(
     pack_max_per_row=8,
     pack_horizon=None,
     pack_allow_uneven_epochs=False,
+    worker_mode="thread",
 ):
     """Build the BERT pretraining loader over balanced shards at ``path``.
 
@@ -533,6 +534,7 @@ def get_bert_pretrain_data_loader(
                 batch_size,
                 collate_fn=make_collate(fixed_seq_lengths[b]),
                 prefetch=prefetch,
+                worker_mode=worker_mode,
             ) for b in bin_ids
         ]
         return BertPretrainBinned(loaders,
@@ -541,7 +543,8 @@ def get_bert_pretrain_data_loader(
                                   logger=logger)
     if packing:
         inner = DataLoader(make_dataset(file_paths), batch_size,
-                           collate_fn=None, prefetch=prefetch)
+                           collate_fn=None, prefetch=prefetch,
+                           worker_mode=worker_mode)
         return PackedBertLoader(
             inner,
             BertPackedCollate(tokenizer, pack_seq_length, pack_rows,
@@ -560,4 +563,5 @@ def get_bert_pretrain_data_loader(
         batch_size,
         collate_fn=make_collate(fixed),
         prefetch=prefetch,
+        worker_mode=worker_mode,
     )

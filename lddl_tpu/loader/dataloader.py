@@ -1,11 +1,19 @@
-"""Batch iteration: worker-threaded DataLoader + synchronized Binned wrapper.
+"""Batch iteration: worker-threaded or worker-process DataLoader +
+synchronized Binned wrapper.
 
 Reference parity: lddl/torch/dataloader.py. The reference rides
-torch.utils.data.DataLoader worker *processes*; our workers are threads —
-the hot per-sample work (pyarrow decode, HF fast tokenizer) releases the
-GIL, and thread workers keep the determinism contract trivially (a FIFO
-queue per worker + fixed round-robin service order reproduces the exact
-batch order of a synchronous run).
+torch.utils.data.DataLoader worker *processes*
+(lddl/torch/bert.py:386, persistent_workers=True); we offer both:
+
+- ``worker_mode="thread"`` (default): the hot per-sample work (pyarrow
+  decode, numpy collate) releases the GIL, threads share the batch memory
+  with the consumer (no pickle copy), and determinism is trivial.
+- ``worker_mode="process"``: one spawned process per worker, rebuilt each
+  epoch from the dataset's pure (seed, epoch, dp, worker) stream
+  definition — no state handoff. Batches cross the process boundary
+  pickled, so this wins only when collate cost dominates the copy
+  (GIL-bound tokenize-heavy transforms on many-core hosts). Both modes
+  produce identical batches in identical order (tested).
 """
 
 import queue
@@ -13,6 +21,29 @@ import threading
 
 from ..utils import rng as lrng
 from ..utils.logging import DatasetLogger
+
+
+def _process_worker_main(dataset, worker_idx, epoch, batch_size, collate_fn,
+                         rng_spec, out_q):
+    """Top-level so spawn can import it; rebuilds the worker's stream and
+    streams collated batches into the queue."""
+    try:
+        if rng_spec is not None:
+            g = lrng.sample_rng(*rng_spec)
+            collate = lambda b: collate_fn(b, g=g)  # noqa: E731
+        else:
+            collate = collate_fn or (lambda b: b)
+        batch = []
+        for sample in dataset.worker_stream(epoch, worker_idx):
+            batch.append(sample)
+            if len(batch) == batch_size:
+                out_q.put(("batch", collate(batch)))
+                batch = []
+        if batch:
+            out_q.put(("batch", collate(batch)))
+        out_q.put(("end", None))
+    except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+        out_q.put(("error", "{}: {}".format(type(e).__name__, e)))
 
 
 class DataLoader:
@@ -24,13 +55,18 @@ class DataLoader:
     is a pure function of (base_seed, epoch).
     """
 
-    def __init__(self, dataset, batch_size, collate_fn=None, prefetch=2):
+    def __init__(self, dataset, batch_size, collate_fn=None, prefetch=2,
+                 worker_mode="thread"):
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
+        if worker_mode not in ("thread", "process"):
+            raise ValueError("worker_mode must be thread|process")
         self.dataset = dataset
         self.batch_size = batch_size
+        self._user_collate = collate_fn  # None = raw samples (picklable)
         self._collate_fn = collate_fn or (lambda samples: samples)
         self._prefetch = max(1, prefetch)
+        self._worker_mode = worker_mode
 
     @property
     def num_batches_per_worker(self):
@@ -86,7 +122,63 @@ class DataLoader:
                             ds.dp_rank, worker_idx)
         return lambda batch: self._collate_fn(batch, g=g)
 
+    def _iter_process(self):
+        import multiprocessing
+        ctx = multiprocessing.get_context("spawn")
+        ds = self.dataset
+        epoch = ds.advance_epoch()
+        n = ds.num_workers
+        queues = [ctx.Queue(maxsize=self._prefetch) for _ in range(n)]
+        rng = getattr(self._collate_fn, "needs_rng", False)
+        procs = [
+            ctx.Process(
+                target=_process_worker_main,
+                args=(ds, w, epoch, self.batch_size, self._user_collate,
+                      ((ds.base_seed, self._COLLATE_RNG_TAG, epoch,
+                        ds.dp_rank, w) if rng else None),
+                      queues[w]),
+                daemon=True)
+            for w in range(n)
+        ]
+        for p in procs:
+            p.start()
+        live = list(range(n))
+        try:
+            while live:
+                for w in list(live):
+                    while True:
+                        # Timed get + liveness check: a worker killed
+                        # without enqueueing (OOM killer, segfault in
+                        # native code) must raise here, not hang the
+                        # training loop forever.
+                        try:
+                            kind, payload = queues[w].get(timeout=5.0)
+                            break
+                        except queue.Empty:
+                            p = procs[w]
+                            if not p.is_alive():
+                                raise RuntimeError(
+                                    "loader worker {} died (exit code {}) "
+                                    "without reporting".format(
+                                        w, p.exitcode))
+                    if kind == "error":
+                        raise RuntimeError(
+                            "loader worker {} failed: {}".format(w, payload))
+                    if kind == "end":
+                        live.remove(w)
+                        continue
+                    yield payload
+        finally:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            for p in procs:
+                p.join(timeout=5)
+
     def __iter__(self):
+        if self._worker_mode == "process":
+            yield from self._iter_process()
+            return
         streams = self.dataset.start_epoch()
         stop = threading.Event()
         queues = [queue.Queue(maxsize=self._prefetch) for _ in streams]

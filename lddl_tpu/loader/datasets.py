@@ -193,6 +193,11 @@ class ParquetDataset:
     def epoch(self):
         return self._epoch
 
+    def advance_epoch(self):
+        """Advance the epoch counter (no streams built); returns it."""
+        self._epoch += 1
+        return self._epoch
+
     def start_epoch(self):
         """Advance to the next epoch; returns per-worker sample streams.
 
@@ -200,28 +205,33 @@ class ParquetDataset:
         then this dp group takes ``files[dp_rank::num_dp_groups]`` and
         worker w takes every num_workers-th of those.
         """
-        self._epoch += 1
-        world_g = lrng.world_rng(self._base_seed, self._epoch)
+        self.advance_epoch()
+        return [self.worker_stream(self._epoch, w)
+                for w in range(self._num_workers)]
+
+    def worker_stream(self, epoch, w):
+        """Worker ``w``'s sample stream for ``epoch`` — a pure function of
+        (files, base_seed, epoch, dp group, worker), so process-mode
+        workers rebuild their own stream after a pickle round-trip without
+        any state handoff."""
+        world_g = lrng.world_rng(self._base_seed, epoch)
         files = list(self._files)
         lrng.shuffle(world_g, files)
         group_files = files[self._dp_rank::self._num_dp_groups]
-        streams = []
-        for w in range(self._num_workers):
-            worker_files = group_files[w::self._num_workers]
-            worker_g = lrng.worker_rng(self._base_seed, self._epoch,
-                                       self._dp_rank, self._num_dp_groups, w,
-                                       self._num_workers)
-            buf = ShuffleBuffer(
-                worker_files,
-                self._num_samples_per_file * len(worker_files),
-                self._decode_record_batch,
-                self._shuffle_buffer_size,
-                self._shuffle_buffer_warmup_factor,
-                worker_g,
-                logger=self._logger,
-            )
-            streams.append(self._transformed(buf))
-        return streams
+        worker_files = group_files[w::self._num_workers]
+        worker_g = lrng.worker_rng(self._base_seed, epoch,
+                                   self._dp_rank, self._num_dp_groups, w,
+                                   self._num_workers)
+        buf = ShuffleBuffer(
+            worker_files,
+            self._num_samples_per_file * len(worker_files),
+            self._decode_record_batch,
+            self._shuffle_buffer_size,
+            self._shuffle_buffer_warmup_factor,
+            worker_g,
+            logger=self._logger,
+        )
+        return self._transformed(buf)
 
     def _transformed(self, stream):
         if self._transform is None:

@@ -94,6 +94,15 @@ class BertConfig:
     def __post_init__(self):
         if self.attention_impl not in ("dense", "ring", "flash"):
             raise ValueError("attention_impl must be dense|ring|flash")
+        if self.attention_impl != "dense" and self.attention_dropout > 0:
+            import warnings
+            warnings.warn(
+                "attention_impl='{}' skips attention-probability dropout "
+                "(standard for blockwise kernels): with attention_dropout="
+                "{} it trains a slightly different model than 'dense'. "
+                "Set attention_dropout=0.0 to silence this.".format(
+                    self.attention_impl, self.attention_dropout),
+                stacklevel=2)
 
     @staticmethod
     def bert_base(**kw):

@@ -5,7 +5,10 @@ from .distributed import (
     ThreadGroupCommunicator,
     get_communicator,
 )
-from .mesh import make_mesh, AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP, AXIS_PP, AXIS_EP, DATA_AXES
+from .mesh import (make_mesh, AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP,
+                   AXIS_PP, DATA_AXES)
+from .pipeline import (make_pipelined_encoder, reference_encoder,
+                       stack_layer_params, unstack_layer_params)
 
 __all__ = [
     "Communicator",
@@ -19,6 +22,9 @@ __all__ = [
     "AXIS_TP",
     "AXIS_SP",
     "AXIS_PP",
-    "AXIS_EP",
     "DATA_AXES",
+    "make_pipelined_encoder",
+    "reference_encoder",
+    "stack_layer_params",
+    "unstack_layer_params",
 ]

@@ -12,8 +12,7 @@ Canonical axis names used across lddl_tpu (a subset may be present):
     fsdp  fully-sharded DP       (batch dim + param shards)
     tp    tensor parallel        (hidden dims)
     sp    sequence/context par.  (sequence dim)
-    pp    pipeline parallel      (layer stages)
-    ep    expert parallel        (MoE experts)
+    pp    pipeline parallel      (layer stages; parallel/pipeline.py)
 
 Batches are sharded over DATA_AXES = ('dp', 'fsdp'); all devices that share
 the same (dp, fsdp) coordinate — i.e. TP/PP/SP peers — receive identical
@@ -28,7 +27,6 @@ AXIS_FSDP = "fsdp"
 AXIS_TP = "tp"
 AXIS_SP = "sp"
 AXIS_PP = "pp"
-AXIS_EP = "ep"
 
 # Mesh axes over which the global batch is sharded.
 DATA_AXES = (AXIS_DP, AXIS_FSDP)

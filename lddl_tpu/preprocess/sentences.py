@@ -7,9 +7,12 @@ egress-restricted, so we ship a self-contained rule-based splitter and use
 punkt only when its data is actually present on disk.
 
 The rule-based splitter targets the same corpora (Wikipedia / books / news):
-split on [.!?] + closing quotes/brackets followed by whitespace and an
-uppercase/digit/quote start, with guards for common abbreviations, initials,
-decimal numbers, and ellipses.
+split at [.!?] + closing quotes/brackets + whitespace before anything but a
+lowercase letter (lowercase continuations split only after ! / ?, punkt
+behavior), with guards for common abbreviations, initials, decimal numbers
+and ellipses on '.' boundaries, and punkt-style attachment of bare list
+enumerators to the preceding sentence. Measured against a punkt oracle:
+SPLITTER_DRIFT.json (F1 0.909, benchmarks/splitter_drift.py).
 """
 
 import re

@@ -56,6 +56,13 @@ class DatasetLogger:
             pathlib.Path(log_dir).mkdir(parents=True, exist_ok=True)
         self._loggers = {}
 
+    def __getstate__(self):
+        # logging.Logger objects don't pickle (process-mode loader workers
+        # ship the dataset, which carries this); they rebuild lazily.
+        state = self.__dict__.copy()
+        state["_loggers"] = {}
+        return state
+
     @property
     def rank(self):
         return self._rank

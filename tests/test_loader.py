@@ -205,6 +205,59 @@ def test_binned_loader_multi_worker_determinism(pipeline):
     assert n == len(l1)
 
 
+def test_process_workers_match_thread_workers(pipeline):
+    """worker_mode='process' must reproduce the thread loader bit-for-bit:
+    same batches, same order, same dynamic masks (the worker stream and
+    collate RNG are pure functions of (seed, epoch, dp, worker))."""
+    for kind in ("dyn", "bin"):
+        lt = _loader(pipeline, kind, num_workers=2)
+        lp = _loader(pipeline, kind, num_workers=2, worker_mode="process")
+        bt, bp = list(lt), list(lp)
+        assert len(bt) == len(bp)
+        for x, y in zip(bt, bp):
+            assert sorted(x) == sorted(y)
+            for key in x:
+                import numpy as np
+                np.testing.assert_array_equal(x[key], y[key], err_msg=key)
+
+
+def test_process_worker_failure_surfaces(pipeline, tmp_path):
+    """A dying worker process raises in the consumer, not a hang."""
+    import pytest
+    loader = _loader(pipeline, "dyn", num_workers=1, worker_mode="process")
+    # Poison the dataset: point one file at a non-parquet path.
+    loader.dataset._files[0] = str(tmp_path / "missing.parquet")
+    with pytest.raises(Exception):
+        list(loader)
+
+
+def _killing_decode(b):
+    """decode_record_batch that SIGKILLs its own worker process mid-file
+    (picklable for the spawn worker)."""
+    import os
+    import signal
+    yield "first"
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def test_process_worker_sigkill_raises_not_hangs(pipeline, tmp_path):
+    """A worker killed without enqueueing anything (OOM killer, native
+    segfault) must raise in the consumer within the liveness timeout."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import pytest
+    from lddl_tpu.loader import DataLoader, ParquetDataset
+
+    path = str(tmp_path / "shard-0.parquet")
+    pq.write_table(pa.table({"A": [str(i) for i in range(64)]}), path)
+    ds = ParquetDataset([path], base_seed=0, num_workers=1,
+                        shuffle_buffer_size=8, shuffle_buffer_warmup_factor=2,
+                        decode_record_batch=_killing_decode)
+    loader = DataLoader(ds, batch_size=4, worker_mode="process")
+    with pytest.raises(RuntimeError, match="died|failed"):
+        list(loader)
+
+
 def test_dynamic_masking_stats(pipeline):
     loader = _loader(pipeline, "dyn", batch_size=32)
     masked = 0
