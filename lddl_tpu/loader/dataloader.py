@@ -26,24 +26,36 @@ from ..utils.logging import DatasetLogger
 def _process_worker_main(dataset, worker_idx, epoch, batch_size, collate_fn,
                          rng_spec, out_q):
     """Top-level so spawn can import it; rebuilds the worker's stream and
-    streams collated batches into the queue."""
+    streams collated batches into the queue.
+
+    Batches are pickled HERE (bytes on the queue), not by mp.Queue's
+    feeder thread: a feeder-thread pickling error would silently drop the
+    batch and still deliver a clean 'end' — pickling in this try block
+    turns it into a forwarded error instead."""
+    import pickle
+
     try:
         if rng_spec is not None:
             g = lrng.sample_rng(*rng_spec)
             collate = lambda b: collate_fn(b, g=g)  # noqa: E731
         else:
             collate = collate_fn or (lambda b: b)
+
+        def put_batch(b):
+            out_q.put(("batch", pickle.dumps(collate(b), protocol=-1)))
+
         batch = []
         for sample in dataset.worker_stream(epoch, worker_idx):
             batch.append(sample)
             if len(batch) == batch_size:
-                out_q.put(("batch", collate(batch)))
+                put_batch(batch)
                 batch = []
         if batch:
-            out_q.put(("batch", collate(batch)))
+            put_batch(batch)
         out_q.put(("end", None))
-    except BaseException as e:  # noqa: BLE001 - forwarded to consumer
-        out_q.put(("error", "{}: {}".format(type(e).__name__, e)))
+    except BaseException:  # noqa: BLE001 - forwarded to consumer
+        import traceback
+        out_q.put(("error", traceback.format_exc()))
 
 
 class DataLoader:
@@ -130,6 +142,7 @@ class DataLoader:
         n = ds.num_workers
         queues = [ctx.Queue(maxsize=self._prefetch) for _ in range(n)]
         rng = getattr(self._collate_fn, "needs_rng", False)
+        import pickle
         procs = [
             ctx.Process(
                 target=_process_worker_main,
@@ -140,10 +153,12 @@ class DataLoader:
                 daemon=True)
             for w in range(n)
         ]
-        for p in procs:
-            p.start()
         live = list(range(n))
         try:
+            # Inside the try: a start() failure (unpicklable dataset or
+            # collate) must still terminate the workers already running.
+            for p in procs:
+                p.start()
             while live:
                 for w in list(live):
                     while True:
@@ -163,17 +178,18 @@ class DataLoader:
                                         w, p.exitcode))
                     if kind == "error":
                         raise RuntimeError(
-                            "loader worker {} failed: {}".format(w, payload))
+                            "loader worker {} failed:\n{}".format(w, payload))
                     if kind == "end":
                         live.remove(w)
                         continue
-                    yield payload
+                    yield pickle.loads(payload)
         finally:
             for p in procs:
                 if p.is_alive():
                     p.terminate()
             for p in procs:
-                p.join(timeout=5)
+                if p.pid is not None:  # join() on a never-started Process
+                    p.join(timeout=5)  # raises
 
     def __iter__(self):
         if self._worker_mode == "process":

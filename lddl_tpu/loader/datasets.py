@@ -206,18 +206,24 @@ class ParquetDataset:
         worker w takes every num_workers-th of those.
         """
         self.advance_epoch()
-        return [self.worker_stream(self._epoch, w)
+        group_files = self._epoch_group_files(self._epoch)  # shuffle once
+        return [self.worker_stream(self._epoch, w, _group_files=group_files)
                 for w in range(self._num_workers)]
 
-    def worker_stream(self, epoch, w):
-        """Worker ``w``'s sample stream for ``epoch`` — a pure function of
-        (files, base_seed, epoch, dp group, worker), so process-mode
-        workers rebuild their own stream after a pickle round-trip without
-        any state handoff."""
+    def _epoch_group_files(self, epoch):
         world_g = lrng.world_rng(self._base_seed, epoch)
         files = list(self._files)
         lrng.shuffle(world_g, files)
-        group_files = files[self._dp_rank::self._num_dp_groups]
+        return files[self._dp_rank::self._num_dp_groups]
+
+    def worker_stream(self, epoch, w, _group_files=None):
+        """Worker ``w``'s sample stream for ``epoch`` — a pure function of
+        (files, base_seed, epoch, dp group, worker), so process-mode
+        workers rebuild their own stream after a pickle round-trip without
+        any state handoff. (start_epoch passes the epoch file shuffle in
+        to avoid repeating it per worker.)"""
+        group_files = (_group_files if _group_files is not None
+                       else self._epoch_group_files(epoch))
         worker_files = group_files[w::self._num_workers]
         worker_g = lrng.worker_rng(self._base_seed, epoch,
                                    self._dp_rank, self._num_dp_groups, w,

@@ -14,6 +14,11 @@ The two halves compose into exact resume:
 
 (orbax writes are atomic — a crash mid-save leaves the previous step
 intact; ``keep`` bounds disk use.)
+
+Compatibility: restore maps by tree structure. Round 2 moved the FFN
+params from layer_i/{intermediate,ffn_output} to
+layer_i/ffn/{intermediate,output}; round-1 checkpoints do not restore
+against the current tree (pre-release break, no shim shipped).
 """
 
 import jax
@@ -25,6 +30,9 @@ import os
 
 def _manager(ckpt_dir, keep=3, create=False):
     import orbax.checkpoint as ocp
+    # orbax requires an absolute directory; a relative path (natural from
+    # a CLI flag) would fail deep inside orbax at save/restore time.
+    ckpt_dir = os.path.abspath(ckpt_dir)
     options = ocp.CheckpointManagerOptions(max_to_keep=keep, create=create)
     return ocp.CheckpointManager(ckpt_dir, options=options)
 

@@ -17,6 +17,37 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "lddl_native.cpp")
 TABLES = os.path.join(_DIR, "unicode_tables.h")
 LIB = os.path.join(_DIR, "_lddl_native.so")
+LIB_META = LIB + ".meta"
+
+
+def _march():
+    return os.environ.get("LDDL_TPU_NATIVE_MARCH", "native")
+
+
+def _lib_meta_tag():
+    """Identifies what the cached .so was built FOR. -march=native bakes
+    the build host's ISA into a .so cached in the package directory; on a
+    shared tree (NFS, prebuilt image) a different host must rebuild
+    instead of SIGILL-ing, so the march setting joins the staleness
+    check. 'native' is intentionally not resolved to a concrete ISA: two
+    heterogeneous hosts sharing a tree should pin LDDL_TPU_NATIVE_MARCH."""
+    import platform
+    tag = "march=" + _march()
+    if _march() == "native":
+        tag += ";host=" + platform.machine()
+        # A concrete per-microarch signal where available (x86 flags set
+        # differs across generations; cheap single read).
+        try:
+            with open("/proc/cpuinfo") as f:
+                for line in f:
+                    if line.startswith("flags"):
+                        import hashlib
+                        tag += ";cpuflags=" + hashlib.sha256(
+                            line.encode()).hexdigest()[:12]
+                        break
+        except OSError:
+            pass
+    return tag
 
 
 def _stale(target, sources):
@@ -24,6 +55,16 @@ def _stale(target, sources):
         return True
     t = os.path.getmtime(target)
     return any(os.path.getmtime(s) > t for s in sources if os.path.exists(s))
+
+
+def _lib_stale():
+    if _stale(LIB, [SRC, TABLES]):
+        return True
+    try:
+        with open(LIB_META) as f:
+            return f.read().strip() != _lib_meta_tag()
+    except OSError:
+        return True
 
 
 def _tables_stale():
@@ -69,7 +110,7 @@ def _build_lock():
 def ensure_built(verbose=False):
     """Build (if stale) and return the .so path, or None on failure."""
     try:
-        if not _tables_stale() and not _stale(LIB, [SRC, TABLES]):
+        if not _tables_stale() and not _lib_stale():
             return LIB
         with _build_lock():
             # Re-check under the lock: another process may have finished.
@@ -83,7 +124,7 @@ def ensure_built(verbose=False):
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
-            if _stale(LIB, [SRC, TABLES]):
+            if _lib_stale():
                 fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
                 os.close(fd)
                 try:
@@ -91,10 +132,10 @@ def ensure_built(verbose=False):
                     # machine that runs it (2x on the WordPiece/UTF-8 hot
                     # loops vs plain -O3). Heterogeneous fleets sharing
                     # one prebuilt image can pin a baseline arch via
-                    # LDDL_TPU_NATIVE_MARCH (e.g. x86-64-v2).
-                    march = os.environ.get("LDDL_TPU_NATIVE_MARCH",
-                                           "native")
-                    cmd = ["g++", "-O3", "-march=" + march, "-std=c++17",
+                    # LDDL_TPU_NATIVE_MARCH (e.g. x86-64-v2); a host whose
+                    # arch tag mismatches the cached .so rebuilds instead
+                    # of SIGILL-ing (_lib_meta_tag in the staleness check).
+                    cmd = ["g++", "-O3", "-march=" + _march(), "-std=c++17",
                            "-shared", "-fPIC",
                            SRC, "-o", tmp]
                     proc = subprocess.run(cmd, capture_output=True, text=True)
@@ -103,6 +144,10 @@ def ensure_built(verbose=False):
                             print("native build failed:\n" + proc.stderr)
                         return None
                     os.replace(tmp, LIB)  # atomic
+                    meta_tmp = tmp + ".meta"
+                    with open(meta_tmp, "w") as f:
+                        f.write(_lib_meta_tag() + "\n")
+                    os.replace(meta_tmp, LIB_META)
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)

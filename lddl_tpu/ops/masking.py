@@ -94,6 +94,11 @@ def mask_whole_word_batch_numpy(ids, candidate, num_to_predict, g, mask_id,
     stream is engine-checkable): scores [N,L], action [N,L], random ids
     [N,L] — selection order is the stable ascending argsort of each
     group's head-column score.
+
+    NOTE: this batched selection consumes a different draw stream than the
+    removed round-1 per-row loop, so wwm static masks for a given
+    (seed, bucket) differ from round-1 outputs — regenerate any round-1
+    wwm datasets rather than mixing them with current ones.
     """
     n, width = ids.shape
     scores = g.random(ids.shape)
