@@ -43,17 +43,19 @@ def _ring_attention_local(q, k, v, kv_mask, axis_name):
     import jax
     import jax.numpy as jnp
     ring_size = jax.lax.psum(1, axis_name)
-    scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
-    # Accumulate in fp32 regardless of activation dtype: the running
-    # max/denominator arithmetic is exactly the flash-attention recipe.
-    qf = q.astype(jnp.float32)
+    scale = jnp.float32(1.0) / jnp.sqrt(q.shape[-1]).astype(jnp.float32)
 
     perm = [(i, (i + 1) % ring_size) for i in range(ring_size)]
 
     def one_block(carry, is_last):
         k_blk, v_blk, mask_blk, m, l, acc = carry
-        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            k_blk.astype(jnp.float32)) * scale
+        # Matmul OPERANDS stay in the stored dtype (bf16 in training) with
+        # fp32 ACCUMULATION (preferred_element_type): the MXU runs
+        # bf16 x bf16 -> fp32 at full rate but fp32 x fp32 at ~1/4 rate —
+        # the same measured fix as ops/flash_attention.py. The running
+        # max/denominator arithmetic stays fp32 (flash recipe).
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                            preferred_element_type=jnp.float32) * scale
         bias = jnp.where(mask_blk[:, None, None, :] > 0, 0.0, -1e9)
         s = scores + bias
         m_new = jnp.maximum(m, s.max(axis=-1))
@@ -61,8 +63,9 @@ def _ring_attention_local(q, k, v, kv_mask, axis_name):
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         acc_new = (acc * corr[..., None]
-                   + jnp.einsum("bhqk,bkhd->bhqd", p,
-                                v_blk.astype(jnp.float32)))
+                   + jnp.einsum("bhqk,bkhd->bhqd", p.astype(v_blk.dtype),
+                                v_blk,
+                                preferred_element_type=jnp.float32))
         # The last block's rotation would only be discarded: skip it
         # (1/ring_size of the ring traffic).
         k_nxt, v_nxt, mask_nxt = jax.lax.cond(

@@ -4,6 +4,7 @@ from .distributed import (
     JaxCommunicator,
     ThreadGroupCommunicator,
     get_communicator,
+    node_info,
 )
 from .mesh import (make_mesh, AXIS_DP, AXIS_FSDP, AXIS_TP, AXIS_SP,
                    AXIS_PP, DATA_AXES)
@@ -16,6 +17,7 @@ __all__ = [
     "JaxCommunicator",
     "ThreadGroupCommunicator",
     "get_communicator",
+    "node_info",
     "make_mesh",
     "AXIS_DP",
     "AXIS_FSDP",

@@ -220,3 +220,22 @@ def get_communicator():
     if jax.process_count() > 1:
         return JaxCommunicator()
     return LocalCommunicator()
+
+
+def node_info():
+    """(node_rank, num_nodes) of THIS host — real host identity, not a
+    dp-group approximation. On TPU, one jax process == one host.
+    Deliberately side-effect free: reads jax.distributed state ONLY when
+    it is already initialized (jax.process_index() would initialize the
+    backend, breaking a later jax.distributed.initialize()); (0, 1) when
+    jax is absent, single-process, or not yet initialized. (Replaces the
+    reference's env-var walk, lddl/torch/utils.py:49-91.)"""
+    try:
+        import jax
+        if not jax.distributed.is_initialized():
+            return 0, 1
+        from jax._src import distributed
+        state = distributed.global_state
+        return int(state.process_id), int(state.num_processes)
+    except Exception:
+        return 0, 1

@@ -43,9 +43,15 @@ class DatasetLogger:
         log_level=logging.INFO,
         rank=0,
         local_rank=0,
-        node_rank=0,
+        node_rank=None,
         worker_rank=0,
     ):
+        if node_rank is None:
+            # Real host identity by default (side-effect-free; 0 when
+            # jax.distributed is not initialized) — every construction
+            # site gets correct 'node:' scoping without plumbing.
+            from ..parallel.distributed import node_info
+            node_rank, _ = node_info()
         self._log_dir = log_dir
         self._log_level = log_level
         self._rank = rank
