@@ -134,17 +134,21 @@ def main():
     reps = args.reps or 2
 
     if args.quick:
-        shapes = [(4, 64), (4, 128)]
+        configs = [("bert_base", 4, 64), ("bert_base", 4, 128)]
         base = dict(vocab_size=1024, hidden_size=64, num_layers=2,
                     num_heads=4, intermediate_size=128)
     else:
-        shapes = [(16, 512), (4, 2048)]
+        # bert_large @ L=512 is the reference's own headline pretraining
+        # config (phase2); base @ 2048 exercises the long-context story.
+        configs = [("bert_base", 16, 512), ("bert_base", 4, 2048),
+                   ("bert_large", 8, 512)]
         base = {}
 
     results = []
-    for batch, seq_len in shapes:
+    for family, batch, seq_len in configs:
         for impl in ("dense", "flash"):
-            cfg = BertConfig.bert_base(
+            make = getattr(BertConfig, family)
+            cfg = make(
                 attention_impl=impl, attention_dropout=0.0,
                 max_position_embeddings=max(512, seq_len), **base)
             try:
@@ -155,6 +159,7 @@ def main():
                        "seq_len": seq_len,
                        "error": "{}: {}".format(type(e).__name__,
                                                 str(e)[:300])}
+            row["model"] = family
             print(row, flush=True)
             results.append(row)
 
@@ -162,7 +167,8 @@ def main():
         "device": str(device),
         "device_kind": kind,
         "peak_bf16_tflops": peak,
-        "model": "bert_base (tiny surrogate)" if args.quick else "bert_base",
+        "model": ("tiny surrogates" if args.quick
+                  else "per-row (bert_base + bert_large)"),
         "method": ("each timed dispatch = {} optimizer steps in one jitted "
                    "lax.scan (make_sharded_multi_step); per-step time = "
                    "wall / ({}x{}); MFU = matmul-FLOPs / step_time / "

@@ -86,4 +86,26 @@ python benchmarks/mock_train.py \
   --log-freq 20 \
   --fixed-seq-lengths 128
 
+echo "== 8. sequence packing (unbinned preprocess -> packed loader) =="
+python -m lddl_tpu.cli.preprocess_bert_pretrain \
+  --wikipedia "$DATA/wiki" \
+  --sink "$DATA/pre_unb" \
+  --vocab-file "$DATA/vocab.txt" \
+  --target-seq-length "$SEQ_LEN" \
+  --duplicate-factor 2 \
+  --sample-ratio 1.0 \
+  --num-blocks 8
+python -m lddl_tpu.cli.balance_shards \
+  --indir "$DATA/pre_unb" --outdir "$DATA/bal_unb" --num-shards "$NUM_SHARDS"
+python - "$DATA" <<'EOF'
+import sys
+from lddl_tpu.loader import get_bert_pretrain_data_loader
+loader = get_bert_pretrain_data_loader(
+    sys.argv[1] + "/bal_unb", vocab_file=sys.argv[1] + "/vocab.txt",
+    batch_size=32, pack_seq_length=256, pack_rows=8)
+n = sum(1 for _ in loader)
+print("packed: {} batches of [8, 256], {} samples, pad ratio {:.2%}".format(
+    n, loader.n_samples, loader.pad_ratio))
+EOF
+
 echo "example complete: $DATA"
