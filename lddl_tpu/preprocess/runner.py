@@ -685,8 +685,11 @@ def run_bert_preprocess(
 ):
     """Run the full BERT preprocessing pipeline (see run_sharded_pipeline
     for the SPMD execution contract). ``num_workers`` > 1 fans the bucket
-    work out over a local process pool per host; ``resume=True`` continues
-    a crashed/failed run from its unit ledger."""
+    work out over a local SPAWN process pool per host — when calling this
+    from a script (rather than the CLI), guard the call with
+    ``if __name__ == "__main__":`` or spawn re-executes your module
+    (standard multiprocessing semantics). ``resume=True`` continues a
+    crashed/failed run from its unit ledger."""
     config = config or BertPretrainConfig()
     if output_format not in ("parquet", "txt"):
         raise ValueError("output_format must be parquet|txt")
