@@ -8,12 +8,13 @@ torch.utils.data.DataLoader worker *processes*
 - ``worker_mode="thread"`` (default): the hot per-sample work (pyarrow
   decode, numpy collate) releases the GIL, threads share the batch memory
   with the consumer (no pickle copy), and determinism is trivial.
-- ``worker_mode="process"``: one spawned process per worker, rebuilt each
-  epoch from the dataset's pure (seed, epoch, dp, worker) stream
-  definition — no state handoff. Batches cross the process boundary
-  pickled, so this wins only when collate cost dominates the copy
-  (GIL-bound tokenize-heavy transforms on many-core hosts). Both modes
-  produce identical batches in identical order (tested).
+- ``worker_mode="process"``: PERSISTENT spawned workers (the reference's
+  persistent_workers=True) — spawned once, each epoch is a command; the
+  worker rebuilds its stream from the dataset's pure (seed, epoch, dp,
+  worker) definition, no state handoff. Batches cross the process
+  boundary pickled, so this wins only when collate cost dominates the
+  copy (GIL-bound tokenize-heavy transforms on many-core hosts). Both
+  modes produce identical batches in identical order (tested).
 """
 
 import queue
@@ -23,10 +24,9 @@ from ..utils import rng as lrng
 from ..utils.logging import DatasetLogger
 
 
-def _process_worker_main(dataset, worker_idx, epoch, batch_size, collate_fn,
-                         rng_spec, out_q):
-    """Top-level so spawn can import it; rebuilds the worker's stream and
-    streams collated batches into the queue.
+def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
+                      rng_spec, out_q):
+    """Stream one epoch's collated batches into the queue.
 
     Batches are pickled HERE (bytes on the queue), not by mp.Queue's
     feeder thread: a feeder-thread pickling error would silently drop the
@@ -58,6 +58,22 @@ def _process_worker_main(dataset, worker_idx, epoch, batch_size, collate_fn,
         out_q.put(("error", traceback.format_exc()))
 
 
+def _persistent_worker_main(dataset, worker_idx, batch_size, collate_fn,
+                            cmd_q, out_q):
+    """Persistent process-worker loop (the reference's
+    persistent_workers=True, lddl/torch/bert.py:386): spawn once, then
+    serve ("epoch", n, rng_spec) commands until ("stop",). The worker's
+    pickled dataset copy never advances its epoch counter — every stream
+    is the pure function dataset.worker_stream(epoch, w)."""
+    while True:
+        cmd = cmd_q.get()
+        if cmd[0] == "stop":
+            return
+        _, epoch, rng_spec = cmd
+        _stream_one_epoch(dataset, worker_idx, epoch, batch_size,
+                          collate_fn, rng_spec, out_q)
+
+
 class DataLoader:
     """Iterates a ParquetDataset in batches.
 
@@ -79,6 +95,10 @@ class DataLoader:
         self._collate_fn = collate_fn or (lambda samples: samples)
         self._prefetch = max(1, prefetch)
         self._worker_mode = worker_mode
+        self._procs = self._cmd_qs = self._out_qs = None
+        self._finalizer = None
+        self._pool_gen = 0
+        self._epoch_active = False
 
     @property
     def num_batches_per_worker(self):
@@ -134,31 +154,99 @@ class DataLoader:
                             ds.dp_rank, worker_idx)
         return lambda batch: self._collate_fn(batch, g=g)
 
-    def _iter_process(self):
+    def _ensure_worker_pool(self):
+        """Spawn the persistent worker pool once (reference:
+        persistent_workers=True); respawned automatically after a failed
+        or abandoned epoch tears it down, or when a worker died while
+        idle between epochs (OOM killer etc.)."""
+        if self._procs is not None:
+            if all(p.is_alive() for p in self._procs):
+                return
+            self.shutdown_workers()
         import multiprocessing
+        import weakref
         ctx = multiprocessing.get_context("spawn")
         ds = self.dataset
-        epoch = ds.advance_epoch()
         n = ds.num_workers
-        queues = [ctx.Queue(maxsize=self._prefetch) for _ in range(n)]
-        rng = getattr(self._collate_fn, "needs_rng", False)
-        import pickle
+        self._cmd_qs = [ctx.Queue() for _ in range(n)]
+        self._out_qs = [ctx.Queue(maxsize=self._prefetch) for _ in range(n)]
         procs = [
             ctx.Process(
-                target=_process_worker_main,
-                args=(ds, w, epoch, self.batch_size, self._user_collate,
-                      ((ds.base_seed, self._COLLATE_RNG_TAG, epoch,
-                        ds.dp_rank, w) if rng else None),
-                      queues[w]),
+                target=_persistent_worker_main,
+                args=(ds, w, self.batch_size, self._user_collate,
+                      self._cmd_qs[w], self._out_qs[w]),
                 daemon=True)
             for w in range(n)
         ]
-        live = list(range(n))
         try:
-            # Inside the try: a start() failure (unpicklable dataset or
-            # collate) must still terminate the workers already running.
             for p in procs:
                 p.start()
+        except BaseException:
+            for p in procs:
+                if p.is_alive():
+                    p.terminate()
+            raise
+        self._procs = procs
+        self._pool_gen += 1
+        # GC safety net: daemon workers die with the interpreter anyway,
+        # but a finalizer releases them as soon as the loader is dropped.
+        self._finalizer = weakref.finalize(
+            self, DataLoader._shutdown_procs, procs)
+
+    @staticmethod
+    def _shutdown_procs(procs, grace_s=0):
+        if grace_s:
+            for p in procs:
+                if p.pid is not None:
+                    p.join(timeout=grace_s)
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            if p.pid is not None:
+                p.join(timeout=5)
+
+    def shutdown_workers(self):
+        """Stop persistent process workers (no-op in thread mode):
+        graceful ("stop",) command with a short grace period, then
+        terminate stragglers."""
+        if self._procs is None:
+            return
+        for q in self._cmd_qs:
+            try:
+                q.put(("stop",))
+            except Exception:  # noqa: BLE001 - queue may be broken
+                pass
+        self._shutdown_procs(self._procs, grace_s=2)
+        if self._finalizer is not None:
+            self._finalizer.detach()
+        self._procs = self._cmd_qs = self._out_qs = None
+        self._finalizer = None
+
+    def _iter_process(self):
+        import pickle
+        ds = self.dataset
+        epoch = ds.advance_epoch()
+        rng = getattr(self._collate_fn, "needs_rng", False)
+        if self._epoch_active:
+            # A previous epoch's iterator is still mid-stream on the
+            # shared queues (partially consumed and kept alive): its
+            # leftovers would masquerade as this epoch's data. Tear down
+            # and respawn for a clean slate.
+            self.shutdown_workers()
+            self._epoch_active = False
+        self._ensure_worker_pool()
+        gen = self._pool_gen
+        self._epoch_active = True
+        procs, out_qs = self._procs, self._out_qs
+        n = len(procs)
+        for w in range(n):
+            self._cmd_qs[w].put(
+                ("epoch", epoch,
+                 (ds.base_seed, self._COLLATE_RNG_TAG, epoch, ds.dp_rank, w)
+                 if rng else None))
+        live = list(range(n))
+        try:
             while live:
                 for w in list(live):
                     while True:
@@ -167,15 +255,14 @@ class DataLoader:
                         # native code) must raise here, not hang the
                         # training loop forever.
                         try:
-                            kind, payload = queues[w].get(timeout=5.0)
+                            kind, payload = out_qs[w].get(timeout=5.0)
                             break
                         except queue.Empty:
-                            p = procs[w]
-                            if not p.is_alive():
+                            if not procs[w].is_alive():
                                 raise RuntimeError(
                                     "loader worker {} died (exit code {}) "
                                     "without reporting".format(
-                                        w, p.exitcode))
+                                        w, procs[w].exitcode))
                     if kind == "error":
                         raise RuntimeError(
                             "loader worker {} failed:\n{}".format(w, payload))
@@ -184,12 +271,16 @@ class DataLoader:
                         continue
                     yield pickle.loads(payload)
         finally:
-            for p in procs:
-                if p.is_alive():
-                    p.terminate()
-            for p in procs:
-                if p.pid is not None:  # join() on a never-started Process
-                    p.join(timeout=5)  # raises
+            if live:
+                # Failed or abandoned mid-epoch: workers are mid-stream
+                # with no way to fast-forward — tear the pool down (next
+                # epoch respawns it), UNLESS a newer epoch already
+                # replaced this pool (a stale abandoned iterator being
+                # GC'd must not kill the successor's workers).
+                if self._pool_gen == gen and self._procs is not None:
+                    self.shutdown_workers()
+            if self._pool_gen == gen:
+                self._epoch_active = False
 
     def __iter__(self):
         if self._worker_mode == "process":
