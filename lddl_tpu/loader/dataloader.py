@@ -339,6 +339,12 @@ class Binned:
         raise NotImplementedError("Binned is abstract: use a subclass that "
                                   "knows the batch structure")
 
+    def shutdown_workers(self):
+        """Stop every bin loader's persistent process workers (no-op in
+        thread mode)."""
+        for dl in self._dataloaders:
+            dl.shutdown_workers()
+
     def __iter__(self):
         self._epoch += 1
         world_g = lrng.world_rng(self._base_seed, self._epoch)
@@ -357,3 +363,11 @@ class Binned:
         assert sum(remaining) == 0, (
             "bin bookkeeping out of sync: {} samples unaccounted".format(
                 sum(remaining)))
+        # Let each bin iterator finish NATURALLY (consume its end-of-epoch
+        # marker): count-based iteration leaves generators suspended on
+        # their last yield, and closing a suspended process-mode iterator
+        # looks like mid-epoch abandonment — tearing down the persistent
+        # worker pools every epoch.
+        for it in iters:
+            leftover = next(it, None)
+            assert leftover is None, "bin served a batch past its count"

@@ -79,6 +79,18 @@ def test_train_step_learns(tiny_cfg):
     assert int(state.step) == 60
 
 
+def test_blockwise_attention_dropout_warns():
+    """ring/flash skip attention-prob dropout; configuring both must warn
+    (silent model drift otherwise), and dropout 0 must stay silent."""
+    import warnings
+    for impl in ("ring", "flash"):
+        with pytest.warns(UserWarning, match="skips attention-probability"):
+            BertConfig.tiny(attention_impl=impl, attention_dropout=0.1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            BertConfig.tiny(attention_impl=impl, attention_dropout=0.0)
+
+
 def test_multi_step_matches_single_steps(tiny_cfg):
     """make_sharded_multi_step(N) over stacked batches is bit-equivalent to
     N sequential single steps with the same seed (the scanned body folds
