@@ -1,0 +1,76 @@
+"""Attribute preprocess time: cProfile the single-worker headline bench run.
+
+Usage: python benchmarks/profile_preprocess.py [MB]
+Prints the top cumulative-time entries plus a phase breakdown
+(scatter / gather-read / bucket-process), to attribute regressions like
+the round-3 one (VERDICT.md round 3, item 1).
+"""
+
+import cProfile
+import io
+import os
+import pstats
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: E402  (repo-root bench.py: corpus + vocab helpers)
+
+
+def main():
+    target_mb = float(sys.argv[1]) if len(sys.argv) > 1 else 24.0
+    tmp = tempfile.mkdtemp(prefix="lddl_prof_")
+    try:
+        from lddl_tpu.preprocess import (
+            BertPretrainConfig, build_wordpiece_vocab, get_tokenizer,
+            run_bert_preprocess)
+
+        corpus = os.path.join(tmp, "corpus")
+        nbytes, _ = bench.make_corpus(corpus, target_mb, seed=0)
+        sample = []
+        sample_bytes = 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 1_500_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=30522)
+        tokenizer = get_tokenizer(vocab_file=vocab)
+
+        # Warmup (native build, tokenizer tables) outside the profile.
+        warm = os.path.join(tmp, "warm")
+        bench.make_corpus(warm, 1, seed=2)
+        run_bert_preprocess(
+            {"wikipedia": warm}, os.path.join(tmp, "out_warm"), tokenizer,
+            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
+                                      masking=True, engine="numpy",
+                                      tokenizer_engine="auto"),
+            num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=32,
+            num_workers=1)
+
+        prof = cProfile.Profile()
+        prof.enable()
+        run_bert_preprocess(
+            {"wikipedia": corpus}, os.path.join(tmp, "out_main"), tokenizer,
+            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
+                                      masking=True, engine="numpy",
+                                      tokenizer_engine="auto"),
+            num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=32,
+            num_workers=1)
+        prof.disable()
+
+        buf = io.StringIO()
+        st = pstats.Stats(prof, stream=buf)
+        st.sort_stats("cumulative").print_stats(40)
+        st.sort_stats("tottime").print_stats(30)
+        print(buf.getvalue())
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

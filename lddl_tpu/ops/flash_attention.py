@@ -181,6 +181,11 @@ def _prep(q, k, v, kv_mask, q_mask):
     b, l, h, d = q.shape
     l_pad = -(-l // 128) * 128
     if q_mask is None:
+        # Plain padding mask: the kernel's test is (msk > 0) & (msk == qm),
+        # so a truthy value other than 1 (int mask from a sum, bool*2, ...)
+        # must normalize to 1 or it would mask EVERYTHING against the
+        # all-ones q side (ADVICE round 3).
+        kv_mask = (kv_mask != 0).astype(jnp.int32)
         q_mask = jnp.ones((b, l), jnp.int32)
     return (_prep_one(q, l_pad), _prep_one(k, l_pad), _prep_one(v, l_pad),
             _prep_mask(kv_mask, l_pad), _prep_mask(q_mask, l_pad),

@@ -4,7 +4,7 @@ Packing puts several short samples in one fixed-length row, separated by
 nothing but their own [CLS]/[SEP] structure, with a *segment id* per token;
 attention is restricted to same-segment tokens (block-diagonal mask), so
 samples cannot see each other. This reclaims the padding FLOPs that
-binning alone leaves behind (3.9% pad at bin-size 64 in LOADER_BENCH) —
+binning alone leaves behind (3.8% pad at bin-size 64 in LOADER_BENCH) —
 the idiomatic fixed-shape TPU move; the reference's Tensor-Core alignment
 trick (lddl/torch/bert.py:91-96) is the nearest, much weaker, analogue.
 

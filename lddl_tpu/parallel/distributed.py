@@ -225,17 +225,27 @@ def get_communicator():
 def node_info():
     """(node_rank, num_nodes) of THIS host — real host identity, not a
     dp-group approximation. On TPU, one jax process == one host.
-    Deliberately side-effect free: reads jax.distributed state ONLY when
-    it is already initialized (jax.process_index() would initialize the
-    backend, breaking a later jax.distributed.initialize()); (0, 1) when
-    jax is absent, single-process, or not yet initialized. (Replaces the
-    reference's env-var walk, lddl/torch/utils.py:49-91.)"""
+    Returns (0, 1) when jax is absent, single-process, or
+    jax.distributed is not yet initialized — in that case NOTHING is
+    queried, so calling this early never interferes with a later
+    jax.distributed.initialize(). Once the distributed backend is up,
+    the public jax.process_index()/process_count() accessors are used
+    (they may touch the local XLA client, which is already inevitable at
+    that point). (Replaces the reference's env-var walk,
+    lddl/torch/utils.py:49-91.)"""
     try:
         import jax
         if not jax.distributed.is_initialized():
             return 0, 1
-        from jax._src import distributed
-        state = distributed.global_state
-        return int(state.process_id), int(state.num_processes)
+        # Public accessors are safe once is_initialized() is true (they
+        # read, never initialize, the already-up backend). The private
+        # global_state remains only as a fallback for jax versions whose
+        # process_index() still force-initializes (ADVICE round 3).
+        try:
+            return int(jax.process_index()), int(jax.process_count())
+        except Exception:
+            from jax._src import distributed
+            state = distributed.global_state
+            return int(state.process_id), int(state.num_processes)
     except Exception:
         return 0, 1

@@ -71,6 +71,13 @@ class BartBucketProcessor:
         self.out_dir = out_dir
         self.output_format = output_format
 
+    def fingerprint(self):
+        """Resume-manifest digest (see BertBucketProcessor.fingerprint;
+        no vocab — BART preprocessing is tokenizer-free)."""
+        from .runner import processor_fingerprint
+        return processor_fingerprint(type(self).__name__, self.config,
+                                     self.seed, self.output_format)
+
     def __call__(self, texts, bucket):
         g = lrng.sample_rng(self.seed, 0xBA27, bucket)
         lrng.shuffle(g, texts)

@@ -43,6 +43,12 @@ class _FailOnce:
             raise RuntimeError("injected failure for bucket {}".format(bucket))
         return self.inner(texts, bucket)
 
+    def fingerprint(self):
+        # Delegate so the manifest records the REAL processor digest —
+        # the mismatch tests must pin fingerprint() field sensitivity,
+        # not wrapper-vs-raw inequality.
+        return self.inner.fingerprint()
+
 
 class _KillOnce:
     """SIGKILLs its own worker process for one bucket on the first attempt
@@ -175,6 +181,45 @@ def test_resume_refuses_mismatched_arguments(fixture_dirs, tmp_path):
     with pytest.raises(ValueError, match="fingerprint mismatch"):
         run_sharded_pipeline({"wikipedia": corpus}, out, proc, resume=True,
                              **dict(_RUN_KW, seed=999))
+
+
+def test_resume_refuses_changed_corpus_or_processor_config(fixture_dirs,
+                                                           tmp_path):
+    """Unit identity is not enough: resuming with a different corpus, bin
+    width, masking config or vocab would pass the old unit-plan check yet
+    mix shards from two incompatible configurations (ADVICE round 3)."""
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    from lddl_tpu.preprocess.runner import BertBucketProcessor
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "never.flag")
+    proc = _FailOnce(_bert_processor(vocab, out), [3], flag)
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
+
+    # Different corpus paths, same unit plan.
+    other_corpus = os.path.join(str(tmp_path), "other_corpus")
+    os.makedirs(os.path.join(other_corpus, "source"))
+    with open(os.path.join(other_corpus, "source", "0.txt"), "w") as f:
+        f.write("doc-0 Completely different corpus. Same block plan.\n")
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": other_corpus}, out, proc,
+                             resume=True, **_RUN_KW)
+
+    # Different processor parameters (bin width), same unit plan.
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True)
+    rebinned = BertBucketProcessor(tok, cfg, 4242, out, 16, "parquet")
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, rebinned,
+                             resume=True, **_RUN_KW)
+
+    # Different masking config, same unit plan.
+    cfg2 = BertPretrainConfig(max_seq_length=32, masking=False)
+    remasked = BertBucketProcessor(tok, cfg2, 4242, out, 8, "parquet")
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, remasked,
+                             resume=True, **_RUN_KW)
 
 
 def test_fresh_dir_refuses_without_resume(fixture_dirs, tmp_path):
