@@ -184,7 +184,11 @@ def _prep(q, k, v, kv_mask, q_mask):
         # Plain padding mask: the kernel's test is (msk > 0) & (msk == qm),
         # so a truthy value other than 1 (int mask from a sum, bool*2, ...)
         # must normalize to 1 or it would mask EVERYTHING against the
-        # all-ones q side (ADVICE round 3).
+        # all-ones q side (ADVICE round 3). NOTE: segment ids passed as
+        # kv_mask WITHOUT the matching q_mask also collapse to all-1s here
+        # — packed callers must pass the segment array as BOTH masks (as
+        # models/bert.py does); values are invisible at trace time, so
+        # this cannot be asserted.
         kv_mask = (kv_mask != 0).astype(jnp.int32)
         q_mask = jnp.ones((b, l), jnp.int32)
     return (_prep_one(q, l_pad), _prep_one(k, l_pad), _prep_one(v, l_pad),

@@ -53,13 +53,13 @@ def mask_batch_numpy(ids, candidate, num_to_predict, g, mask_id, vocab_size,
     # num_to_predict values beyond the row width clamp to "take every
     # candidate" (the rank-based behavior).
     k_max = min(max(int(num_to_predict.max()), 1), ids.shape[1])
-    k_of_row = np.clip(num_to_predict, 1, k_max) - 1
-    # Partition at every distinct k in one pass: np.partition with a kth
-    # sequence places each listed index at its sorted position, so
-    # part[i, k_of_row[i]] IS row i's k-th smallest — same thresholds as
-    # a partition+sort of the smallest k_max, without the per-row sort.
-    part = np.partition(scores, np.unique(k_of_row), axis=1)
-    thresh = part[np.arange(ids.shape[0]), k_of_row]
+    # Single partition at k_max-1 + a sort of the k_max-wide slice. (A
+    # multi-kth np.partition at every distinct k was measured 20x slower:
+    # numpy runs one introselect pass per listed kth.)
+    smallest = np.partition(scores, k_max - 1, axis=1)[:, :k_max]
+    smallest.sort(axis=1)
+    thresh = smallest[np.arange(ids.shape[0]),
+                      np.clip(num_to_predict, 1, k_max) - 1]
     selected = (scores <= thresh[:, None]) & candidate
     selected[num_to_predict <= 0] = False
 

@@ -238,15 +238,14 @@ def vocab_words_of(tokenizer):
 
 
 def _canonical_paths(corpus_paths):
-    """Mirror ``discover_source_files``'s accepted shapes (str, list/tuple,
-    {name: path}) with every leaf path realpath'd."""
-    if isinstance(corpus_paths, str):
-        return os.path.realpath(corpus_paths)
-    if isinstance(corpus_paths, dict):
-        return {k: _canonical_paths(v) for k, v in sorted(corpus_paths.items())}
-    if isinstance(corpus_paths, (list, tuple)):
-        return [_canonical_paths(p) for p in corpus_paths]
-    return str(corpus_paths)
+    """``discover_source_files``'s {name: path} dict with every path
+    absolutized (normpath+abspath, NO symlink resolution: realpath would
+    diverge across hosts whose automounters resolve the same logical
+    path differently, spuriously refusing a multi-host resume)."""
+    return {
+        k: os.path.abspath(v) if isinstance(v, str) else str(v)
+        for k, v in sorted(corpus_paths.items())
+    }
 
 
 def processor_fingerprint(*fields):
@@ -586,9 +585,8 @@ def run_sharded_pipeline(
          # Unit identity is not enough: the corpus and the processor's
          # own parameters (vocab, binning, masking, sink format) also
          # define what a ledgered unit's bytes MEAN (ADVICE round 3).
-         # Paths canonicalize via realpath so a resume launched from a
-         # different cwd (relative vs absolute spelling, symlinks) is not
-         # spuriously refused.
+         # Paths absolutize so a resume launched from a different cwd
+         # (relative vs absolute spelling) is not spuriously refused.
          "corpus_paths": json.dumps(
              _canonical_paths(corpus_paths), sort_keys=True, default=str),
          "processor": proc_fp() if callable(proc_fp) else None},
