@@ -68,9 +68,13 @@ def main():
     payload = {
         "device": str(jax.devices()[0]),
         "results": results,
-        "note": ("on a tunneled single-chip host the wall times ride an "
-                 "~100ms remote-dispatch floor; max_abs_err (bf16 "
-                 "rounding scale) is the hardware-correctness record"),
+        "note": ("NUMERICS artifact only: max_abs_err (bf16 rounding "
+                 "scale) is the hardware-correctness record. The *_ms "
+                 "columns are single-dispatch wall times on a tunneled "
+                 "chip = ~100 ms dispatch floor, NOT kernel time. The "
+                 "authoritative speed record is MODEL_BENCH.json "
+                 "(in-model multi-step scan) and STEP_PROFILE.json "
+                 "(device-busy per-op times)."),
     }
     with open(os.path.join(ROOT, "FLASH_ATTENTION_BENCH.json"), "w") as f:
         json.dump(payload, f, indent=1)

@@ -88,8 +88,10 @@ def attach_args():
     p.add_argument("--mesh", default=None,
                    help="axes for --with-model, e.g. dp=2,tp=2,sp=2 "
                         "(default: all devices on dp)")
-    p.add_argument("--attention-impl", choices=("dense", "ring", "flash"),
-                   default="dense", help="for --with-model")
+    p.add_argument("--attention-impl",
+                   choices=("auto", "dense", "ring", "flash"),
+                   default="auto", help="for --with-model (auto = measured "
+                   "per-seq-length dense/flash selection)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize layers (--with-model)")
     return p

@@ -42,16 +42,19 @@ PEAK_BF16_TFLOPS = {
 }
 
 
-def matmul_flops_per_step(cfg, batch, seq_len):
+def matmul_flops_per_step(cfg, batch, seq_len, n_pred=None):
+    """Model matmul FLOPs per optimizer step. ``n_pred`` = positions the
+    MLM head actually projects per row (the gathered head,
+    train.mlm_gather_cap); None = full-sequence head."""
     h, ffn = cfg.hidden_size, cfg.intermediate_size
-    per_token_fwd = (
-        cfg.num_layers * (8 * h * h + 4 * h * ffn + 4 * seq_len * h)
-        + 2 * h * cfg.vocab_size  # tied MLM decode over all positions
-        + 2 * h * h               # MLM transform
-    )
+    enc_per_token = cfg.num_layers * (8 * h * h + 4 * h * ffn
+                                      + 4 * seq_len * h)
+    head_per_pos = 2 * h * cfg.vocab_size + 2 * h * h  # decode + transform
+    head_positions = seq_len if n_pred is None else n_pred
+    per_row_fwd = (enc_per_token * seq_len + head_per_pos * head_positions)
     # Always 3x forward: MFU counts MODEL flops, so remat's recompute is
     # excluded (counting it would be HFU and inflate remat rows by ~33%).
-    return 3 * per_token_fwd * batch * seq_len
+    return 3 * per_row_fwd * batch
 
 
 def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
@@ -65,6 +68,12 @@ def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
                                    seed=1000 + i, segment_split=True)
                for i in range(n_steps)]
     stacked_np = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+
+    from lddl_tpu.models.train import mlm_gather_cap
+    n_pred = (mlm_gather_cap(seq_len)
+              if getattr(cfg, "mlm_gather", False) else None)
+    if n_pred is not None and n_pred >= seq_len:
+        n_pred = None
 
     state, _ = create_train_state(
         cfg, mesh, batches[0],
@@ -88,11 +97,12 @@ def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
 
     last_loss = float(np.asarray(metrics["loss"])[-1])
     step_s = elapsed / (reps * n_steps)
-    flops = matmul_flops_per_step(cfg, batch, seq_len)
+    flops = matmul_flops_per_step(cfg, batch, seq_len, n_pred)
     row = {
         "attention_impl": cfg.attention_impl,
         "batch": batch,
         "seq_len": seq_len,
+        "mlm_gather_positions": n_pred,  # None = full-sequence MLM head
         "remat": cfg.remat,
         "n_steps_per_dispatch": n_steps,
         "timed_steps": reps * n_steps,
@@ -145,11 +155,19 @@ def main():
         base = {}
 
     results = []
+    variants = [("dense", True), ("flash", True)]
+    if not args.quick:
+        # The measured cost of the full-sequence MLM head, on the
+        # reference's headline config only.
+        variants.append(("dense", False))
     for family, batch, seq_len in configs:
-        for impl in ("dense", "flash"):
+        for impl, gather in variants:
+            if not gather and (family, seq_len) != ("bert_large", 512):
+                continue
             make = getattr(BertConfig, family)
             cfg = make(
                 attention_impl=impl, attention_dropout=0.0,
+                mlm_gather=gather,
                 max_position_embeddings=max(512, seq_len), **base)
             try:
                 row = bench_config(mesh, cfg, batch, seq_len, n_steps, reps,

@@ -1,0 +1,250 @@
+"""On-chip payoff of sequence packing: useful (non-pad) tokens/s through
+the REAL jitted train step, fed by the REAL loader (VERDICT round 3
+item 2 — the packing feature's reason to exist, measured).
+
+Regimes (same corpus, preprocessed at max_seq_length=128 — the
+reference's phase-1 config, where samples are much shorter than a
+TPU-friendly row):
+
+- packed:  loader packs samples into fixed [R, 512] rows with segment
+           ids; BertForPreTrainingPacked; ~1% pad, one compiled shape.
+- binned:  static per-bin shapes (bin_size 32) at the samples' native
+           lengths; one compiled step per bin shape; ~4% pad but small
+           rows (the reference's binning regime, README binning table).
+- fixed:   every batch padded to the full 128 (no binning) — the naive
+           fixed-shape baseline; highest pad.
+
+Metric: useful_tokens_per_s = sum over timed steps of REAL sample tokens
+(packed: segments != 0; unpacked: attention_mask == 1) / elapsed. Each
+regime runs its idiomatic batch size at an equal ~4k useful-token budget
+per step. Compile time is excluded (steady-state, like MODEL_BENCH).
+
+Writes PACKING_BENCH.json. Usage:
+    python benchmarks/packing_bench.py [--quick]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from collections import defaultdict
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import numpy as np
+
+import bench  # repo-root corpus/vocab helpers
+
+
+def build_dataset(tmp, corpus_mb, bin_size):
+    """Preprocess the same corpus binned AND unbinned (packing requires
+    unbinned shards — rows are always exactly pack_seq_length wide).
+    Returns (binned_shards, unbinned_shards, vocab)."""
+    from lddl_tpu.balance import balance_shards
+    from lddl_tpu.preprocess import (BertPretrainConfig,
+                                     build_wordpiece_vocab, get_tokenizer,
+                                     run_bert_preprocess)
+    corpus = os.path.join(tmp, "corpus")
+    bench.make_corpus(corpus, corpus_mb, seed=11)
+    sample, sb = [], 0
+    with open(os.path.join(corpus, "source", "0.txt"), encoding="utf-8") as f:
+        for line in f:
+            sample.append(line.split(None, 1)[1])
+            sb += len(line)
+            if sb > 1_000_000:
+                break
+    vocab = build_wordpiece_vocab(sample, os.path.join(tmp, "vocab.txt"),
+                                  vocab_size=30522)
+    tokenizer = get_tokenizer(vocab_file=vocab)
+    shards = {}
+    for tag, bins in (("binned", bin_size), ("unbinned", None)):
+        out = os.path.join(tmp, "parts_" + tag)
+        run_bert_preprocess(
+            {"wikipedia": corpus}, out, tokenizer,
+            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=2,
+                                      masking=True),
+            num_blocks=8, sample_ratio=1.0, seed=4242, bin_size=bins,
+            num_workers=1)
+        shards[tag] = os.path.join(tmp, "shards_" + tag)
+        balance_shards(out, shards[tag], num_shards=4)
+    return shards["binned"], shards["unbinned"], vocab
+
+
+def collect_batches(loader_kwargs, shards, vocab, want_steps, batch_size):
+    """Pull real batches, grouped by shape; return {shape: [batch, ...]}."""
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    loader = get_bert_pretrain_data_loader(
+        shards, vocab_file=vocab, batch_size=batch_size, base_seed=77,
+        **loader_kwargs)
+    groups = defaultdict(list)
+    need = want_steps * 4
+    n = 0
+    for batch in loader:
+        key = tuple(batch["input_ids"].shape)
+        if batch["input_ids"].shape[0] == batch_size:
+            groups[key].append(batch)
+        n += 1
+        if n >= need:
+            break
+    return groups
+
+
+def useful_tokens(batch):
+    if "segments" in batch:
+        return int((np.asarray(batch["segments"]) > 0).sum())
+    return int(np.asarray(batch["attention_mask"]).sum())
+
+
+def run_regime(name, groups, model, cfg, mesh, n_steps, reps):
+    import jax
+    from lddl_tpu.loader import to_device_step_batches
+    from lddl_tpu.models import create_train_state, make_sharded_multi_step
+    from lddl_tpu.models.train import make_optimizer
+
+    total_useful = 0
+    total_s = 0.0
+    total_steps = 0
+    compiles = 0
+    for shape, batches in sorted(groups.items(), key=lambda kv: -len(kv[1])):
+        if len(batches) < n_steps:
+            continue
+        use = batches[:n_steps]
+        stacked_np = {k: np.stack([b[k] for b in use]) for k in use[0]}
+        state, _ = create_train_state(
+            cfg, mesh, use[0], model=model,
+            optimizer=make_optimizer(warmup_steps=5,
+                                     total_steps=n_steps * (reps + 1) + 5))
+        multi = make_sharded_multi_step(mesh, cfg, n_steps, model=model)
+        stacked = to_device_step_batches(stacked_np, mesh)
+        state, metrics = multi(state, stacked, seed=0)  # compile + warm
+        float(np.asarray(metrics["loss"])[-1])  # true sync (readback)
+        compiles += 1
+        t0 = time.perf_counter()
+        for r in range(reps):
+            state, metrics = multi(state, stacked, seed=r + 1)
+        float(np.asarray(metrics["loss"])[-1])
+        dt = time.perf_counter() - t0
+        shape_useful = sum(useful_tokens(b) for b in use)
+        total_useful += shape_useful * reps
+        total_s += dt
+        total_steps += n_steps * reps
+        del state, metrics, stacked
+    if total_steps == 0:
+        return {"regime": name, "error": "no shape group reached n_steps"}
+    return {
+        "regime": name,
+        "compiled_shapes": compiles,
+        "timed_steps": total_steps,
+        "useful_tokens_per_s": round(total_useful / total_s, 1),
+        "step_ms": round(total_s / total_steps * 1e3, 3),
+        "useful_tokens_per_step": round(total_useful / total_steps, 1),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="tiny model + short runs (harness smoke test)")
+    p.add_argument("--corpus-mb", type=float, default=6.0)
+    p.add_argument("--n-steps", type=int, default=None)
+    p.add_argument("--reps", type=int, default=2)
+    args = p.parse_args()
+
+    import jax
+    from lddl_tpu.models import BertConfig
+    from lddl_tpu.models.bert import BertForPreTraining, BertForPreTrainingPacked
+    from lddl_tpu.parallel import make_mesh
+
+    n_steps = args.n_steps or (4 if args.quick else 16)
+    device = jax.devices()[0]
+    mesh = make_mesh({"dp": 1}, devices=[device])
+    if args.quick:
+        base = dict(vocab_size=30592, hidden_size=128, num_layers=2,
+                    num_heads=4, intermediate_size=256)
+        make = BertConfig.bert_base
+    else:
+        base = {}
+        make = BertConfig.bert_base
+    cfg = make(attention_dropout=0.0, max_position_embeddings=512, **base)
+
+    tmp = tempfile.mkdtemp(prefix="lddl_packbench_")
+    try:
+        binned_shards, unbinned_shards, vocab = build_dataset(
+            tmp, args.corpus_mb, bin_size=32)
+        regimes = []
+        # packed: [8, 512] rows, segments in-batch (unbinned shards).
+        groups = collect_batches(
+            dict(pack_seq_length=512, pack_rows=8), unbinned_shards, vocab,
+            n_steps, batch_size=8)
+        regimes.append(("packed_512x8", BertForPreTrainingPacked(cfg),
+                        groups))
+        # binned: native per-bin shapes, 32 rows.
+        groups = collect_batches({}, binned_shards, vocab, n_steps,
+                                 batch_size=32)
+        regimes.append(("binned_native", BertForPreTraining(cfg), groups))
+        # fixed: everything padded to 128 (unbinned shards, one shape).
+        groups = collect_batches(
+            dict(fixed_seq_lengths=128), unbinned_shards, vocab, n_steps,
+            batch_size=32)
+        regimes.append(("fixed_128", BertForPreTraining(cfg), groups))
+
+        results = []
+        for name, model, groups in regimes:
+            row = run_regime(name, groups, model, cfg, mesh, n_steps,
+                             args.reps)
+            row["batch_shapes"] = sorted(
+                [list(map(int, s)) + [len(v)] for s, v in groups.items()])
+            print(row, flush=True)
+            results.append(row)
+
+        packed = next((r for r in results
+                       if r["regime"].startswith("packed")
+                       and "useful_tokens_per_s" in r), None)
+        binned = next((r for r in results
+                       if r["regime"].startswith("binned")
+                       and "useful_tokens_per_s" in r), None)
+        conclusion = None
+        if packed and binned:
+            ratio = (packed["useful_tokens_per_s"]
+                     / binned["useful_tokens_per_s"])
+            conclusion = (
+                "packed {}x binned useful-token throughput. Packing rows "
+                "much longer than the samples adds O(L^2) attention FLOPs "
+                "(block-diagonal masks do not skip the cross-sample "
+                "blocks), so with tight bins the pad reclaim can net out "
+                "negative; packing pays vs naive fixed-length padding and "
+                "where a single static shape is required (pipeline "
+                "stages). Default recommendation: binned shards."
+                .format(round(ratio, 3)))
+        payload = {
+            "conclusion": conclusion,
+            "device": str(device),
+            "model": "bert_base (samples preprocessed at max_seq_length="
+                     "128, duplicate_factor=2)",
+            "method": ("useful tokens = non-pad sample tokens through the "
+                       "jitted multi-step train scan fed by real loader "
+                       "batches; {} steps/dispatch, {} reps, compile "
+                       "excluded; readback-synced (block_until_ready is "
+                       "not a reliable barrier on the tunneled runtime)"
+                       .format(n_steps, args.reps)),
+            "packed_vs_binned_useful_tokens": (
+                round(packed["useful_tokens_per_s"]
+                      / binned["useful_tokens_per_s"], 3)
+                if packed and binned else None),
+            "results": results,
+        }
+        with open(os.path.join(ROOT, "PACKING_BENCH.json"), "w") as f:
+            json.dump(payload, f, indent=1)
+        print(json.dumps({"packed_vs_binned":
+                          payload["packed_vs_binned_useful_tokens"]}))
+        print("wrote PACKING_BENCH.json")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -350,10 +350,9 @@ class Binned:
         world_g = lrng.world_rng(self._base_seed, self._epoch)
         remaining = [len(dl.dataset) for dl in self._dataloaders]
         iters = [iter(dl) for dl in self._dataloaders]
+        bin_ids = list(range(len(iters)))  # allocation-free hot loop
         for i in range(len(self)):
-            bin_id = lrng.choices(world_g,
-                                  list(range(len(iters))),
-                                  weights=remaining)[0]
+            bin_id = lrng.choices(world_g, bin_ids, weights=remaining)[0]
             self._logger.to("rank").info(
                 "iteration {} selects bin {}".format(i, bin_id))
             assert remaining[bin_id] > 0

@@ -20,6 +20,20 @@ import flax.linen as nn
 with_logical = nn.with_logical_constraint
 
 
+def resolve_auto_impl(seq_len, blockwise_ok, attention_dropout):
+    """attention_impl="auto" -> "flash"|"dense" (measured selection,
+    MODEL_BENCH.json): the pallas flash kernel wins where attention
+    dominates (L >= ~1024 — 33.9% vs 27.0% MFU at L=2048, round 4) but
+    loses ~2 MFU points at the reference's L=512 headline config to its
+    per-layer layout transposes (XLA's dense attention fuses into the
+    surrounding ops; the kernel's [B*H, L, D] relayout does not). Flash
+    is picked only when it computes the SAME math as dense (it skips
+    attention-prob dropout, so dropout > 0 pins dense): auto never
+    changes the trained model, only the speed."""
+    return ("flash" if blockwise_ok and seq_len >= 1024
+            and attention_dropout == 0.0 else "dense")
+
+
 class MultiHeadAttention(nn.Module):
     """softmax(QK^T/sqrt(d) + bias) V with logical-axis sharding.
 
@@ -62,8 +76,12 @@ class MultiHeadAttention(nn.Module):
         # padding mask; causal/cross calls always take the dense path.
         blockwise_ok = (q_input is kv_input and extra_bias is None
                         and padding_mask is not None)
+        impl = self.attention_impl
+        if impl == "auto":
+            impl = resolve_auto_impl(q_input.shape[1], blockwise_ok,
+                                     self.dropout)
         use_ring = False
-        if self.attention_impl == "ring" and blockwise_ok:
+        if impl == "ring" and blockwise_ok:
             from jax.sharding import get_abstract_mesh
             mesh = get_abstract_mesh()
             use_ring = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
@@ -85,7 +103,7 @@ class MultiHeadAttention(nn.Module):
             k = split_heads(proj("key")(kv_input), "seq")
             v = split_heads(proj("value")(kv_input), "seq")
             ctx = ring_attention(q, k, v, padding_mask, mesh)
-        elif self.attention_impl == "flash" and blockwise_ok:
+        elif impl == "flash" and blockwise_ok:
             # The pallas fused kernel (ops/flash_attention.py); attention-
             # prob dropout is skipped, like ring. Packed rows hand the
             # kernel per-token segment ids — the block-diagonal mask is

@@ -44,17 +44,22 @@ class BartConfig:
     layer_norm_eps: float = 1e-5
     initializer_range: float = 0.02
     dtype: Any = jnp.bfloat16
-    # "ring" engages sequence-parallel attention for the ENCODER's
-    # bidirectional self-attention (models/attention.py); the decoder's
-    # causal self-attention and the cross-attention stay dense.
-    attention_impl: str = "dense"
+    # "auto"/"flash"/"ring" engage blockwise attention for the ENCODER's
+    # bidirectional self-attention only (models/attention.py); the
+    # decoder's causal self-attention and the cross-attention stay dense.
+    # See BertConfig.attention_impl for the auto selection rule.
+    attention_impl: str = "auto"
     # Rematerialize encoder/decoder layers on backward (jax.checkpoint):
     # ~1/3 more FLOPs for O(num_layers) less activation memory.
     remat: bool = False
+    # Dropout PRNG implementation; see BertConfig.dropout_rng_impl.
+    dropout_rng_impl: str = "rbg"
 
     def __post_init__(self):
-        if self.attention_impl not in ("dense", "ring", "flash"):
-            raise ValueError("attention_impl must be dense|ring|flash")
+        if self.attention_impl not in ("auto", "dense", "ring", "flash"):
+            raise ValueError("attention_impl must be auto|dense|ring|flash")
+        if self.dropout_rng_impl not in ("rbg", "threefry"):
+            raise ValueError("dropout_rng_impl must be rbg|threefry")
 
     @staticmethod
     def bart_base(**kw):

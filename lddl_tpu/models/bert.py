@@ -78,23 +78,48 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     dtype: Any = jnp.bfloat16  # activations; params stay fp32
-    # "dense": all-gather from sp into full-sequence attention
-    # (Megatron-SP). "ring": sequence-parallel exact attention — K/V
-    # blocks rotate over the sp ring (ops/ring_attention.py), no device
-    # ever holds the full sequence; attention-prob dropout is skipped
-    # under ring (standard for blockwise kernels). Falls back to dense
-    # when the mesh has no sp axis (or sp == 1).
-    attention_impl: str = "dense"
+    # "auto" (default): dense at short L, the pallas flash kernel where
+    # it measurably wins (L >= 1024) AND computes identical math
+    # (attention_dropout == 0 — flash skips prob dropout); the choice is
+    # per traced sequence length, so no config silently runs the slower
+    # impl (MODEL_BENCH.json). "dense": all-gather from sp into
+    # full-sequence attention (Megatron-SP). "flash": always the pallas
+    # kernel. "ring": sequence-parallel exact attention — K/V blocks
+    # rotate over the sp ring (ops/ring_attention.py), no device ever
+    # holds the full sequence; attention-prob dropout is skipped under
+    # ring (standard for blockwise kernels). Falls back to dense when
+    # the mesh has no sp axis (or sp == 1).
+    attention_impl: str = "auto"
     # Rematerialize each encoder layer on the backward pass
     # (jax.checkpoint): activations are recomputed instead of stored,
     # trading ~1/3 more FLOPs for O(num_layers) less activation memory —
     # the standard lever for long sequences / big batches on HBM.
     remat: bool = False
+    # Run the MLM head only at the masked positions: the train step
+    # gathers the ~15% masked columns (a static cap P, see
+    # train.mlm_gather_cap) before the vocab projection, cutting the
+    # head's matmul FLOPs and its [B, L, vocab] fp32 logits (the largest
+    # tensor of the step, and pure overhead at the ~85% unmasked
+    # positions — loss and gradients are IDENTICAL, since unmasked logits
+    # never contribute). Direct model.apply calls without
+    # masked_positions still produce full [B, L, vocab] logits.
+    mlm_gather: bool = True
+    # PRNG implementation for the per-step dropout key. "rbg" drives the
+    # TPU's hardware RNG through XLA's RngBitGenerator — measured 14.3 ms
+    # (15%) off a bert_large L=512 train step vs threefry, which computes
+    # the hash chain on the VPU (STEP_PROFILE.json). Dropout masks remain
+    # deterministic in (seed, step) for a fixed program, but rbg draws are
+    # not guaranteed bit-stable across compiler versions or mesh shapes —
+    # set "threefry" if dropout masks must replay exactly everywhere.
+    dropout_rng_impl: str = "rbg"
 
     def __post_init__(self):
-        if self.attention_impl not in ("dense", "ring", "flash"):
-            raise ValueError("attention_impl must be dense|ring|flash")
-        if self.attention_impl != "dense" and self.attention_dropout > 0:
+        if self.attention_impl not in ("auto", "dense", "ring", "flash"):
+            raise ValueError("attention_impl must be auto|dense|ring|flash")
+        if self.dropout_rng_impl not in ("rbg", "threefry"):
+            raise ValueError("dropout_rng_impl must be rbg|threefry")
+        if self.attention_impl in ("ring", "flash") \
+                and self.attention_dropout > 0:
             import warnings
             warnings.warn(
                 "attention_impl='{}' skips attention-probability dropout "
@@ -227,7 +252,7 @@ class BertForPreTraining(nn.Module):
     @nn.compact
     def __call__(self, input_ids, token_type_ids, attention_mask,
                  segments=None, position_ids=None, cls_positions=None,
-                 deterministic=True):
+                 deterministic=True, masked_positions=None):
         cfg = self.cfg
         x = Embeddings(cfg, name="embeddings")(
             input_ids, token_type_ids, deterministic,
@@ -239,11 +264,19 @@ class BertForPreTraining(nn.Module):
                 x, attention_mask, deterministic, segments)
 
         # MLM head: transform + tied-free decoder to vocab (column-parallel).
+        # With masked_positions [B, P] only those columns are projected
+        # (mlm_logits [B, P, vocab]); loss-equivalent to the full head
+        # because unmasked logits never enter the loss (see cfg.mlm_gather).
+        if masked_positions is not None:
+            xm = jnp.take_along_axis(x, masked_positions[:, :, None], axis=1)
+            xm = with_logical(xm, ("batch", None, "act_embed"))
+        else:
+            xm = x
         h = nn.Dense(
             cfg.hidden_size, dtype=cfg.dtype,
             kernel_init=nn.with_logical_partitioning(
                 _dense_init(cfg), ("embed", "embed_out")),
-            name="mlm_transform")(x)
+            name="mlm_transform")(xm)
         h = nn.gelu(h, approximate=True)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
                          name="mlm_norm")(h)

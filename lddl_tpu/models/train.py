@@ -192,12 +192,86 @@ def _resolve_batch_loss(batch_loss, ignore_index):
                                            ignore_index=ignore_index)
 
 
-def _make_step_fn(model, batch_loss):
+def mlm_gather_cap(seq_len, n_samples_per_row=1):
+    """Static cap P on masked positions per row for the gathered MLM head:
+    the masking budget (15%) plus a 4-sigma binomial margin (dynamic
+    masking draws ~Binomial(L, 0.15) per sample, uncapped), rounded up to
+    a multiple of 8 for layout friendliness. Rows that exceed P (p < 1e-4
+    at 4 sigma) drop the excess labels — counted in the step metrics as
+    ``mlm_dropped_labels``, never silent."""
+    import math
+    l_eff = seq_len / max(n_samples_per_row, 1)
+    per_sample = 0.15 * l_eff + 1.43 * math.sqrt(l_eff)
+    p = int(math.ceil(per_sample)) * max(n_samples_per_row, 1)
+    return min(seq_len, -(-p // 8) * 8)
+
+
+def _dropout_key(model, seed):
+    """Per-step dropout base key honoring cfg.dropout_rng_impl. "threefry"
+    means jax's default threefry2x32 (via PRNGKey, so the name in the
+    config stays version-stable); anything else is passed to
+    jax.random.key(impl=...) verbatim (e.g. "rbg")."""
+    impl = getattr(getattr(model, "cfg", None), "dropout_rng_impl", None)
+    if impl is None or impl == "threefry":
+        return jax.random.PRNGKey(seed)
+    return jax.random.key(seed, impl=impl)
+
+
+def _mlm_gather_prologue(model, batch, ignore_index, enabled):
+    """Shared train/eval gather step: returns (model_kwargs, batch,
+    extra_metrics) — with the gathered MLM head engaged, batch["labels"]
+    is replaced by the gathered [B, P] labels and the dropped-label count
+    is reported. A no-op (({}, batch, {})) when disabled or not
+    applicable."""
+    gather = _mlm_gather_of(model, batch, ignore_index) if enabled else None
+    if gather is None:
+        return {}, batch, {}
+    pos, gathered_labels, dropped = gather
+    return ({"masked_positions": pos}, dict(batch, labels=gathered_labels),
+            {"mlm_dropped_labels": dropped})
+
+
+def _mlm_gather_of(model, batch, ignore_index=-1):
+    """(masked_positions [B,P], gathered labels [B,P], dropped count) when
+    the model opts into the gathered MLM head, else None. Positions are
+    the first P masked columns per row (ascending; rows with fewer than P
+    pad with unmasked columns whose labels are already ignore_index)."""
+    cfg = getattr(model, "cfg", None)
+    if not getattr(cfg, "mlm_gather", False) or "labels" not in batch:
+        return None
+    labels = batch["labels"]
+    seq_len = labels.shape[-1]
+    n_per_row = 1
+    if "cls_positions" in batch:  # packed rows: several samples per row
+        n_per_row = batch["cls_positions"].shape[-1]
+    p = mlm_gather_cap(seq_len, n_per_row)
+    if p >= seq_len:
+        return None  # gather would not shrink anything
+    mask = labels != ignore_index
+    # Strictly-decreasing positive scores at masked columns, 0 elsewhere:
+    # top_k then yields the first P masked positions in ascending order.
+    score = jnp.where(mask, seq_len - jnp.arange(seq_len)[None, :], 0)
+    _, pos = jax.lax.top_k(score, p)
+    gathered = jnp.take_along_axis(labels, pos, axis=1)
+    dropped = mask.sum() - (gathered != ignore_index).sum()
+    return pos, gathered, dropped
+
+
+def _make_step_fn(model, batch_loss, ignore_index=-1, mlm_gather_ok=True):
     """The un-jitted SPMD step body shared by the single- and multi-step
-    entry points: (state, batch, seed) -> (state, metrics)."""
+    entry points: (state, batch, seed) -> (state, metrics).
+
+    ``mlm_gather_ok=False`` disables the gathered MLM head: the gather
+    rewrites batch["labels"] under the DEFAULT BERT loss's conventions
+    (labels are [B, L] MLM ids, ignore_index marks unmasked), so a
+    custom batch_loss with its own label semantics must see the original
+    batch and full-sequence logits."""
 
     def step_fn(state, batch, seed):
-        dropout_rng = jax.random.fold_in(jax.random.PRNGKey(seed), state.step)
+        dropout_rng = jax.random.fold_in(_dropout_key(model, seed),
+                                         state.step)
+        kwargs, batch, extra = _mlm_gather_prologue(
+            model, batch, ignore_index, mlm_gather_ok)
 
         def loss_fn(params):
             outputs = model.apply(
@@ -205,11 +279,14 @@ def _make_step_fn(model, batch_loss):
                 *_batch_inputs(model, batch),
                 deterministic=False,
                 rngs={"dropout": dropout_rng},
+                **kwargs,
             )
             return batch_loss(outputs, batch)
 
         (_, metrics), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)
+        if extra:
+            metrics = dict(metrics, **extra)
         new_state = state.apply_gradients(grads)
         return new_state, metrics
 
@@ -228,7 +305,8 @@ def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
     ignore_index=...))."""
     model = model or BertForPreTraining(config)
     step_fn = _make_step_fn(model,
-                            _resolve_batch_loss(batch_loss, ignore_index))
+                            _resolve_batch_loss(batch_loss, ignore_index),
+                            ignore_index, mlm_gather_ok=batch_loss is None)
 
     jitted = jax.jit(step_fn, donate_argnums=(0,) if donate else ())
 
@@ -259,7 +337,8 @@ def make_sharded_multi_step(mesh, config, n_steps, model=None,
     inside the scan."""
     model = model or BertForPreTraining(config)
     step_fn = _make_step_fn(model,
-                            _resolve_batch_loss(batch_loss, ignore_index))
+                            _resolve_batch_loss(batch_loss, ignore_index),
+                            ignore_index, mlm_gather_ok=batch_loss is None)
 
     def multi_step_fn(state, batches, seed):
         def body(state, batch):
@@ -285,16 +364,22 @@ def make_eval_step(mesh, config, model=None, ignore_index=-1,
         raise ValueError(
             "ignore_index only configures the default BERT loss; bind it "
             "into your batch_loss instead")
+    mlm_gather_ok = batch_loss is None  # default-loss conventions only
     batch_loss = batch_loss or functools.partial(bert_batch_loss,
                                                  ignore_index=ignore_index)
 
     def step_fn(params, batch):
+        kwargs, batch, extra = _mlm_gather_prologue(
+            model, batch, ignore_index, mlm_gather_ok)
         outputs = model.apply(
             {"params": params},
             *_batch_inputs(model, batch),
             deterministic=True,
+            **kwargs,
         )
         _, metrics = batch_loss(outputs, batch)
+        if extra:
+            metrics = dict(metrics, **extra)
         return metrics
 
     jitted = jax.jit(step_fn)

@@ -131,6 +131,107 @@ def test_multi_step_matches_single_steps(tiny_cfg):
                                    rtol=1e-5, atol=1e-6)
 
 
+def test_attention_auto_selection(tiny_cfg):
+    """attention_impl="auto" (the default) resolves by the measured rule —
+    flash only at L >= 1024 with attention_dropout == 0 and a blockwise-
+    compatible call — and at short L produces bit-identical outputs to
+    explicit dense (it IS dense there)."""
+    from lddl_tpu.models.attention import resolve_auto_impl
+    from lddl_tpu.models.bert import BertForPreTraining
+
+    assert resolve_auto_impl(512, True, 0.0) == "dense"
+    assert resolve_auto_impl(1024, True, 0.0) == "flash"
+    assert resolve_auto_impl(2048, True, 0.0) == "flash"
+    assert resolve_auto_impl(2048, True, 0.1) == "dense"  # prob dropout
+    assert resolve_auto_impl(2048, False, 0.0) == "dense"  # causal/cross
+    assert BertConfig.tiny().attention_impl == "auto"
+
+    batch = _fake_batch(tiny_cfg, B=4, L=64, seed=9)
+    outs = {}
+    for impl in ("auto", "dense"):
+        cfg = BertConfig.tiny(attention_impl=impl)
+        model = BertForPreTraining(cfg)
+        variables = model.init(
+            {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+            batch["token_type_ids"], batch["attention_mask"],
+            deterministic=True)
+        outs[impl] = model.apply(variables, batch["input_ids"],
+                                 batch["token_type_ids"],
+                                 batch["attention_mask"],
+                                 deterministic=True)
+    np.testing.assert_array_equal(np.asarray(outs["auto"][0]),
+                                  np.asarray(outs["dense"][0]))
+    np.testing.assert_array_equal(np.asarray(outs["auto"][1]),
+                                  np.asarray(outs["dense"][1]))
+
+
+def test_mlm_gather_matches_dense_head(tiny_cfg):
+    """The gathered MLM head (cfg.mlm_gather, default ON) must produce
+    the same loss, metrics and updated params as the full [B, L, vocab]
+    head when no row overflows the cap — unmasked logits never enter the
+    loss, so gathering them away is a pure FLOP/memory cut."""
+    from lddl_tpu.models.train import _mlm_gather_of, mlm_gather_cap
+
+    mesh = make_mesh({"dp": 4, "sp": 2})
+    batch_np = _fake_batch(tiny_cfg, B=8, L=64, seed=3)
+    opt = make_optimizer(warmup_steps=2, total_steps=20)
+    results = {}
+    for gather in (True, False):
+        cfg = BertConfig.tiny(mlm_gather=gather, hidden_dropout=0.0,
+                              attention_dropout=0.0)
+        state, _ = create_train_state(cfg, mesh, batch_np, optimizer=opt)
+        step = make_sharded_train_step(mesh, cfg, donate=False)
+        state, metrics = step(state, to_device_batch(batch_np, mesh), seed=7)
+        results[gather] = (jax.device_get(state.params),
+                           {k: float(v) for k, v in metrics.items()
+                            if k != "mlm_dropped_labels"})
+    assert results[True][1].keys() == results[False][1].keys()
+    for k in results[False][1]:
+        np.testing.assert_allclose(results[True][1][k], results[False][1][k],
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+    for a, b in zip(jax.tree.leaves(results[True][0]),
+                    jax.tree.leaves(results[False][0])):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=2e-5, atol=1e-6)
+
+    # Overflow accounting: more masked labels than the cap -> the excess
+    # is dropped AND reported, never silent.
+    labels = np.zeros((2, 64), np.int32)  # every column masked
+    cap = mlm_gather_cap(64)
+    model = __import__("lddl_tpu.models.bert", fromlist=["x"]
+                       ).BertForPreTraining(BertConfig.tiny())
+    got = _mlm_gather_of(model, {"labels": labels})
+    assert got is not None
+    pos, gathered, dropped = got
+    assert pos.shape == (2, cap) and gathered.shape == (2, cap)
+    assert int(dropped) == 2 * (64 - cap)
+
+
+def test_mlm_gather_positions_and_logit_shape(tiny_cfg):
+    """Direct model.apply with masked_positions returns [B, P, vocab] and
+    matches the corresponding columns of the full head's logits."""
+    model = __import__("lddl_tpu.models.bert", fromlist=["x"]
+                       ).BertForPreTraining(tiny_cfg)
+    batch = _fake_batch(tiny_cfg, B=4, L=32, seed=5)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch["input_ids"],
+        batch["token_type_ids"], batch["attention_mask"],
+        deterministic=True)
+    full, _ = model.apply(variables, batch["input_ids"],
+                          batch["token_type_ids"], batch["attention_mask"],
+                          deterministic=True)
+    pos = np.stack([np.arange(8, dtype=np.int32)] * 4) * 2  # even columns
+    sub, _ = model.apply(variables, batch["input_ids"],
+                         batch["token_type_ids"], batch["attention_mask"],
+                         deterministic=True, masked_positions=pos)
+    assert sub.shape == (4, 8, tiny_cfg.vocab_size)
+    np.testing.assert_allclose(
+        np.asarray(sub),
+        np.take_along_axis(np.asarray(full), pos[:, :, None], axis=1),
+        rtol=1e-5, atol=1e-5)
+
+
 def test_mesh_portability_same_loss(tiny_cfg):
     """The same seed gives the same initial loss on different meshes —
     sharding must not change the math."""
