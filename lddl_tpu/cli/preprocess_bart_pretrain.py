@@ -25,6 +25,10 @@ def attach_args(parser=None):
     parser.add_argument("--local-workers", type=int, default=0,
                         help="process-pool size per host "
                              "(0 = one per CPU core)")
+    parser.add_argument("--splitter", choices=("rules", "learned"),
+                        default="rules",
+                        help="sentence splitter (see preprocess_bert_"
+                             "pretrain --splitter)")
     parser.add_argument("--output-format", choices=("parquet", "txt"),
                         default="parquet")
     attach_bool_arg(parser, "resume", default=False,
@@ -44,6 +48,7 @@ def main(args=None):
         config=BartPretrainConfig(
             target_seq_length=args.target_seq_length,
             short_seq_prob=args.short_seq_prob,
+            splitter=args.splitter,
         ),
         num_workers=args.local_workers or os.cpu_count() or 1,
         num_blocks=args.num_blocks,

@@ -89,6 +89,8 @@ class DataLoader:
             raise ValueError("batch_size must be >= 1")
         if worker_mode not in ("thread", "process"):
             raise ValueError("worker_mode must be thread|process")
+        if worker_mode == "process":
+            worker_mode = self._check_process_mode(dataset)
         self.dataset = dataset
         self.batch_size = batch_size
         self._user_collate = collate_fn  # None = raw samples (picklable)
@@ -99,6 +101,34 @@ class DataLoader:
         self._finalizer = None
         self._pool_gen = 0
         self._epoch_active = False
+
+    @staticmethod
+    def _check_process_mode(dataset):
+        """Process workers only pay off when spare cores exist: on a
+        single-core host every recorded measurement shows them losing
+        badly to thread mode (LOADER_BENCH.json w4proc rows: 66-415
+        samples/s vs ~16k — spawn, pickle and queue costs with zero
+        parallel upside), so fall back to threads with a warning instead
+        of silently running a known-pathological configuration."""
+        import os
+        if os.environ.get("LDDL_TPU_FORCE_PROCESS_WORKERS"):
+            return "process"  # tests / benchmarks of the mode itself
+        ncpu = os.cpu_count() or 1
+        if ncpu < 2:
+            logger = getattr(dataset, "logger", None)
+            msg = ("worker_mode='process' on a {}-CPU host: falling back "
+                   "to thread mode (process workers measured 40-240x "
+                   "slower without spare cores — LOADER_BENCH.json)"
+                   .format(ncpu))
+            if logger is not None:
+                try:
+                    logger.to("rank").warning(msg)
+                except Exception:
+                    pass
+            import warnings
+            warnings.warn(msg, stacklevel=4)
+            return "thread"
+        return "process"
 
     @property
     def num_batches_per_worker(self):

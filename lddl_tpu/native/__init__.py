@@ -73,7 +73,7 @@ def _load():
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.
         try:
-            if lib.lddl_native_abi_version() != 4:
+            if lib.lddl_native_abi_version() != 5:
                 return None
         except AttributeError:
             return None
@@ -83,6 +83,14 @@ def _load():
         lib.lddl_tok_free.argtypes = [ctypes.c_void_p]
         lib.lddl_tok_set_memo_cap.argtypes = [ctypes.c_void_p,
                                               ctypes.c_int64]
+        lib.lddl_tok_set_splitter.restype = None
+        lib.lddl_tok_set_splitter.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_int64]
+        lib.lddl_split_docs2.restype = ctypes.POINTER(_SplitResult)
+        lib.lddl_split_docs2.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64]
         lib.lddl_join_tokens.restype = None
         lib.lddl_join_tokens.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
@@ -159,18 +167,29 @@ class NativeTokenizer:
     """
 
     def __init__(self, id_to_token, unk_id, do_lower_case=True,
-                 memo_cap=None):
+                 memo_cap=None, splitter_blob=None):
         lib = _load()
         if lib is None:
             raise RuntimeError("native engine unavailable")
         self._args = (list(id_to_token), int(unk_id), bool(do_lower_case),
-                      memo_cap)
+                      memo_cap, splitter_blob)
         self._lib = lib
         buf = "\n".join(id_to_token).encode("utf-8")
         self._handle = lib.lddl_tok_create(buf, len(buf), int(unk_id),
                                            1 if do_lower_case else 0)
         if memo_cap is not None:
             lib.lddl_tok_set_memo_cap(self._handle, int(memo_cap))
+        if splitter_blob:
+            lib.lddl_tok_set_splitter(self._handle, splitter_blob,
+                                      len(splitter_blob))
+
+    def set_splitter(self, blob):
+        """Attach (or clear, blob=None) corpus-learned punkt splitter
+        params — the SplitterParams.serialize() blob. tokenize_docs then
+        splits with the learned decision procedure."""
+        blob = blob or b""
+        self._lib.lddl_tok_set_splitter(self._handle, blob, len(blob))
+        self._args = self._args[:4] + (blob or None,)
 
     def __reduce__(self):
         # ctypes handles cannot cross pickle boundaries; rebuild from the
@@ -245,11 +264,13 @@ def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
     return seq_ids, seq_lens_o, a_lens, rn
 
 
-def split_docs(texts):
+def split_docs(texts, splitter_blob=None):
     """Sentence-split documents natively -> list of sentence lists.
 
-    Same boundaries as preprocess.sentences.split_sentences (enforced by
-    tests); raises RuntimeError when the native engine is unavailable.
+    Same boundaries as preprocess.sentences.split_sentences — or, with
+    ``splitter_blob`` (SplitterParams.serialize()), as
+    split_sentences_learned (enforced by tests); raises RuntimeError when
+    the native engine is unavailable.
     """
     lib = _load()
     if lib is None:
@@ -257,9 +278,9 @@ def split_docs(texts):
     if not texts:
         return []
     buf, offsets = _pack_docs(texts)
-    res = lib.lddl_split_docs(
+    res = lib.lddl_split_docs2(
         buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        len(texts))
+        len(texts), splitter_blob, len(splitter_blob or b""))
     try:
         r = res.contents
         starts = np.ctypeslib.as_array(r.starts, shape=(r.n_sents,)).copy()

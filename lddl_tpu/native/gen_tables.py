@@ -31,9 +31,18 @@ F_CJK = 32        # HF chinese-char ranges (BMP part)
 F_ALPHA = 64      # str.isalpha()
 F_LOWER = 128     # str.islower() (single char)
 F_RE_DIGIT = 256  # Python re \d (str patterns) == category Nd
+F_UPPER = 512     # str.isupper() (single char)
+F_RE_WORD = 1024  # Python re \w (str patterns)
+# CPython str.lower()'s ONLY context-sensitive case is Final_Sigma
+# (U+03A3 -> ς when preceded by a cased char, skipping case-ignorables,
+# and not followed by one). The two predicates are probed from CPython
+# itself rather than hand-ported property tables.
+F_PY_CASED = 2048
+F_PY_CASE_IGNORABLE = 4096
 
 _RE_SPACE = re.compile(r"\s")
 _RE_DIGIT = re.compile(r"\d")
+_RE_WORD = re.compile(r"\w")
 
 # HF is_chinese_char ranges (BMP + astral extension blocks).
 _CJK = ((0x4E00, 0x9FFF), (0x3400, 0x4DBF), (0xF900, 0xFAFF),
@@ -76,7 +85,25 @@ def _flags(cp):
         f |= F_LOWER
     if _RE_DIGIT.match(c):
         f |= F_RE_DIGIT
+    if c.isupper():
+        f |= F_UPPER
+    if _RE_WORD.match(c):
+        f |= F_RE_WORD
+    # Probes against CPython's own Final_Sigma scan: cp is "cased" iff a
+    # sigma directly after it takes the final form; "case-ignorable" iff
+    # it is transparent to that backward scan (and not itself cased).
+    if (c + "Σ").lower().endswith("ς"):
+        f |= F_PY_CASED
+    elif ("A" + c + "Σ").lower().endswith("ς"):
+        f |= F_PY_CASE_IGNORABLE
     return f
+
+
+def _py_lower(cp):
+    """Python str.lower() per codepoint (full case mapping; may expand,
+    e.g. U+0130 -> 2 codepoints). The learned splitter's punkt types are
+    built with str.lower, so the C++ port needs the exact mapping."""
+    return [ord(c) for c in chr(cp).lower()]
 
 
 def _fold_lower_strip(cp):
@@ -257,11 +284,40 @@ def _astral_tables(flags_fn, fold_fn):
     return run_starts, run_flags, folds
 
 
+def _astral_fold_entries(fold_fn):
+    """Non-identity astral fold entries only (no flag-run recompute)."""
+    folds = []
+    for cp in range(0x10000, 0x110000):
+        out = fold_fn(cp)
+        if out != [cp]:
+            assert len(out) <= 3
+            padded = out + [0] * (3 - len(out))
+            folds.append((cp, len(out), padded[0], padded[1], padded[2]))
+    return folds
+
+
 def generate(out_path):
     flags_fn, fold_fn = _make_flags_fn()
     flags = [flags_fn(cp) for cp in range(0x10000)]
     astral_starts, astral_flags, astral_folds = _astral_tables(flags_fn,
                                                                fold_fn)
+    astral_lowers = _astral_fold_entries(_py_lower)
+
+    # str.lower() table (BMP): only non-identity entries materialized.
+    lower_idx = [0xFFFF] * 0x10000
+    lower_entries = []
+    for cp in range(0x10000):
+        if 0xD800 <= cp <= 0xDFFF:
+            continue
+        out = _py_lower(cp)
+        if out == [cp]:
+            continue
+        assert len(out) <= 3
+        if len(lower_entries) >= 0xFFFF:
+            raise RuntimeError("lower entry overflow")
+        lower_idx[cp] = len(lower_entries)
+        padded = out + [0] * (3 - len(out))
+        lower_entries.append((len(out), padded[0], padded[1], padded[2]))
 
     # Fold table: only non-identity entries are materialized.
     fold_idx = [0xFFFF] * 0x10000
@@ -302,7 +358,19 @@ def generate(out_path):
         "#define F_ALPHA {}".format(F_ALPHA),
         "#define F_LOWER {}".format(F_LOWER),
         "#define F_RE_DIGIT {}".format(F_RE_DIGIT),
+        "#define F_UPPER {}".format(F_UPPER),
+        "#define F_RE_WORD {}".format(F_RE_WORD),
+        "#define F_PY_CASED {}".format(F_PY_CASED),
+        "#define F_PY_CASE_IGNORABLE {}".format(F_PY_CASE_IGNORABLE),
         dump("UFLAGS", "uint16_t", flags),
+        dump("LOWER_IDX", "uint16_t", lower_idx),
+        dump("LOWER_N", "uint8_t", [e[0] for e in lower_entries]),
+        dump("LOWER_OUT", "uint32_t",
+             [v for e in lower_entries for v in (e[1], e[2], e[3])]),
+        dump("ALOWER_CP", "uint32_t", [e[0] for e in astral_lowers]),
+        dump("ALOWER_N", "uint8_t", [e[1] for e in astral_lowers]),
+        dump("ALOWER_OUT", "uint32_t",
+             [v for e in astral_lowers for v in (e[2], e[3], e[4])]),
         dump("FOLD_IDX", "uint16_t", fold_idx),
         dump("FOLD_N", "uint8_t", [e[0] for e in entries]),
         dump("FOLD_OUT", "uint32_t",

@@ -1,5 +1,7 @@
 from .readers import Block, plan_blocks, read_documents, split_id_text
-from .sentences import split_sentences
+from .sentences import (SplitterParams, split_sentences,
+                        split_sentences_learned,
+                        train_splitter_params)
 from .tokenizer import get_tokenizer, build_wordpiece_vocab
 from .bert import (
     BertPretrainConfig,
@@ -15,7 +17,10 @@ __all__ = [
     "plan_blocks",
     "read_documents",
     "split_id_text",
+    "SplitterParams",
     "split_sentences",
+    "split_sentences_learned",
+    "train_splitter_params",
     "get_tokenizer",
     "build_wordpiece_vocab",
     "BertPretrainConfig",

@@ -21,7 +21,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from ..utils import rng as lrng
-from .sentences import split_sentences
+from .sentences import split_sentences, split_sentences_learned
 from .runner import run_sharded_pipeline
 
 
@@ -29,13 +29,17 @@ from .runner import run_sharded_pipeline
 class BartPretrainConfig:
     target_seq_length: int = 128
     short_seq_prob: float = 0.1
+    # Sentence splitter: "rules" | "learned" (see BertPretrainConfig).
+    splitter: str = "rules"
 
     def __post_init__(self):
         if self.target_seq_length < 8:
             raise ValueError("target_seq_length too small")
+        if self.splitter not in ("rules", "learned"):
+            raise ValueError("splitter must be rules|learned")
 
 
-def chunks_from_text(text, config, g):
+def chunks_from_text(text, config, g, splitter_params=None):
     """One document -> list of chunk strings (leading-space joined, like
     the reference's ``chunk += " " + sentence``)."""
     base_target = config.target_seq_length - 3
@@ -45,7 +49,9 @@ def chunks_from_text(text, config, g):
     target = base_target
     if config.short_seq_prob > 0 and g.random() < config.short_seq_prob:
         target = int(g.integers(2, base_target + 1))
-    for sentence in split_sentences(text):
+    sentences = (split_sentences_learned(text, splitter_params)
+                 if splitter_params is not None else split_sentences(text))
+    for sentence in sentences:
         chunk += " " + sentence
         num_tokens += len(sentence.split())
         if num_tokens >= target:
@@ -65,25 +71,30 @@ class BartBucketProcessor:
     """Picklable per-bucket BART pipeline stage (pool-friendly; see
     runner.BertBucketProcessor)."""
 
-    def __init__(self, config, seed, out_dir, output_format):
+    def __init__(self, config, seed, out_dir, output_format,
+                 splitter_params=None):
         self.config = config
         self.seed = seed
         self.out_dir = out_dir
         self.output_format = output_format
+        self.splitter_params = splitter_params
 
     def fingerprint(self):
         """Resume-manifest digest (see BertBucketProcessor.fingerprint;
         no vocab — BART preprocessing is tokenizer-free)."""
-        from .runner import processor_fingerprint
+        from .runner import processor_fingerprint, splitter_digest
         return processor_fingerprint(type(self).__name__, self.config,
-                                     self.seed, self.output_format)
+                                     self.seed, self.output_format,
+                                     splitter_digest(self.splitter_params))
 
     def __call__(self, texts, bucket):
         g = lrng.sample_rng(self.seed, 0xBA27, bucket)
         lrng.shuffle(g, texts)
         rows = []
         for text in texts:
-            rows.extend(chunks_from_text(text, self.config, g))
+            rows.extend(chunks_from_text(
+                text, self.config, g,
+                splitter_params=self.splitter_params))
         os.makedirs(self.out_dir, exist_ok=True)
         if self.output_format == "txt":
             path = os.path.join(self.out_dir, "{}.txt".format(bucket))
@@ -120,10 +131,14 @@ def run_bart_preprocess(
     config = config or BartPretrainConfig()
     if output_format not in ("parquet", "txt"):
         raise ValueError("output_format must be parquet|txt")
+    from .runner import train_splitter_params_from_corpus
+    splitter_params = (train_splitter_params_from_corpus(corpus_paths)
+                       if config.splitter == "learned" else None)
     return run_sharded_pipeline(
         corpus_paths,
         out_dir,
-        BartBucketProcessor(config, seed, out_dir, output_format),
+        BartBucketProcessor(config, seed, out_dir, output_format,
+                            splitter_params=splitter_params),
         num_blocks=num_blocks,
         sample_ratio=sample_ratio,
         seed=seed,

@@ -61,6 +61,14 @@ class BertPretrainConfig:
     # (lddl_tpu.native), "hf" = Python splitter + HF fast tokenizer,
     # "auto" = native when buildable + tokenizer-compatible, else hf.
     tokenizer_engine: str = "auto"
+    # Sentence splitter: "rules" = the static rule-based splitter
+    # (self-contained, F1 0.91 vs punkt); "learned" = corpus-trained punkt
+    # parameters driving the punkt decision procedure (F1 0.99 vs an
+    # identically-trained punkt, SPLITTER_DRIFT.json) — the runner trains
+    # them on a deterministic corpus sample at run start (needs nltk at
+    # TRAIN time only; the decision runs nltk-free in Python AND in the
+    # C++ engine, fuzz-pinned to parity).
+    splitter: str = "rules"
 
     def __post_init__(self):
         if self.max_seq_length < 8:
@@ -69,6 +77,8 @@ class BertPretrainConfig:
             raise ValueError("engine must be numpy|jax")
         if self.tokenizer_engine not in ("auto", "hf", "native"):
             raise ValueError("tokenizer_engine must be auto|hf|native")
+        if self.splitter not in ("rules", "learned"):
+            raise ValueError("splitter must be rules|learned")
         if self.max_predictions_per_seq is None:
             self.max_predictions_per_seq = int(
                 np.ceil(self.masked_lm_ratio * self.max_seq_length))
@@ -219,13 +229,24 @@ def _native_semantics_match(backend, do_lower_case):
         return False
 
 
-def documents_from_texts(texts, tokenizer, engine="auto"):
+def _apply_splitter_params(nat, splitter_params):
+    """Attach (or clear) learned splitter params on the cached native
+    engine, re-parsing only when the blob actually changed."""
+    blob = splitter_params.serialize() if splitter_params else None
+    if getattr(nat, "_args", (None,) * 5)[4] != blob:
+        nat.set_splitter(blob)
+
+
+def documents_from_texts(texts, tokenizer, engine="auto",
+                         splitter_params=None):
     """Raw document texts -> documents as lists of per-sentence id lists.
 
     engine "native": one C++ pass (sentence split + normalize + memoized
     WordPiece, lddl_tpu.native) over the whole block. engine "hf": Python
     splitter + one batched fast-tokenizer call (the reference tokenizes
     sentence-by-sentence, pretrain.py:77-97). "auto" prefers native.
+    ``splitter_params`` (sentences.SplitterParams) switches both engines
+    to the corpus-learned punkt splitter.
     """
     tok_info = tokenizer if isinstance(tokenizer, TokenizerInfo) else None
     if tok_info is not None:
@@ -244,10 +265,16 @@ def documents_from_texts(texts, tokenizer, engine="auto"):
                     pass
         nat = tok_info.native_tokenizer()
         if nat is not None:
+            _apply_splitter_params(nat, splitter_params)
             return _documents_from_texts_native(texts, nat)
         if engine == "native":
             raise RuntimeError("native tokenizer engine unavailable")
-    doc_sentences = [split_sentences(t) for t in texts]
+    if splitter_params is not None:
+        from .sentences import split_sentences_learned
+        doc_sentences = [split_sentences_learned(t, splitter_params)
+                         for t in texts]
+    else:
+        doc_sentences = [split_sentences(t) for t in texts]
     flat = [s for sents in doc_sentences for s in sents]
     if not flat:
         return []
@@ -278,13 +305,21 @@ def documents_from_texts(texts, tokenizer, engine="auto"):
     return documents
 
 
-def instances_from_texts(texts, tok_info, config, seed, bucket):
+def instances_from_texts(texts, tok_info, config, seed, bucket,
+                         splitter_params=None):
     """Texts -> InstanceBatch via the configured engine (the whole bucket
     hot path: split + tokenize + pair creation). Both engines emit
     identical batches: tokenization parity plus the shared CounterRNG
-    contract make the native path a bit-exact replay of the Python one."""
+    contract make the native path a bit-exact replay of the Python one.
+    ``splitter_params`` is required when config.splitter == "learned"
+    (the runner trains and passes it)."""
     if not isinstance(tok_info, TokenizerInfo):
         tok_info = TokenizerInfo(tok_info)
+    if config.splitter == "learned" and splitter_params is None:
+        raise ValueError(
+            "config.splitter='learned' needs splitter_params (see "
+            "sentences.train_splitter_params; run_bert_preprocess trains "
+            "them automatically)")
     engine = config.tokenizer_engine
     nat = (tok_info.native_tokenizer()
            if engine in ("auto", "native") else None)
@@ -292,13 +327,15 @@ def instances_from_texts(texts, tok_info, config, seed, bucket):
         raise RuntimeError("native tokenizer engine unavailable")
     if nat is not None:
         from .. import native
+        _apply_splitter_params(nat, splitter_params)
         ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
         seq_ids, seq_lens, a_lens, rn = native.bert_pairs(
             ids, sent_lens, doc_counts, config.max_seq_length,
             config.short_seq_prob, config.duplicate_factor, seed, bucket,
             tok_info.cls_id, tok_info.sep_id)
         return InstanceBatch(seq_ids, seq_lens, a_lens, rn)
-    documents = documents_from_texts(texts, tok_info, engine="hf")
+    documents = documents_from_texts(texts, tok_info, engine="hf",
+                                     splitter_params=splitter_params)
     instances = pairs_from_documents(documents, config, seed, bucket)
     return InstanceBatch.from_pairs(instances, tok_info.cls_id,
                                     tok_info.sep_id)

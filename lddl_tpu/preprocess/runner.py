@@ -248,6 +248,14 @@ def _canonical_paths(corpus_paths):
     }
 
 
+def splitter_digest(splitter_params):
+    """One digest rule for learned splitter params in resume fingerprints
+    (BERT and BART must invalidate identically on a splitter change)."""
+    if splitter_params is None:
+        return "none"
+    return hashlib.sha256(splitter_params.serialize()).hexdigest()[:16]
+
+
 def processor_fingerprint(*fields):
     """Shared digest skeleton for processor resume fingerprints: joins the
     stringified fields (dataclass configs serialize as sorted json) and
@@ -352,13 +360,14 @@ class BertBucketProcessor:
     engine are rebuilt lazily once per process."""
 
     def __init__(self, tokenizer, config, seed, out_dir, bin_size,
-                 output_format):
+                 output_format, splitter_params=None):
         self.tokenizer = tokenizer
         self.config = config
         self.seed = seed
         self.out_dir = out_dir
         self.bin_size = bin_size
         self.output_format = output_format
+        self.splitter_params = splitter_params  # picklable SplitterParams
         self._tok_info = None
 
     def __getstate__(self):
@@ -385,14 +394,16 @@ class BertBucketProcessor:
             separators=(",", ":")).encode()).hexdigest()[:16]
         return processor_fingerprint(type(self).__name__, vocab, self.config,
                                      self.seed, self.bin_size,
-                                     self.output_format)
+                                     self.output_format,
+                                     splitter_digest(self.splitter_params))
 
     def __call__(self, texts, bucket):
         config, seed = self.config, self.seed
         g = lrng.sample_rng(seed, 0x9A1A, bucket)
         lrng.shuffle(g, texts)
         batch = instances_from_texts(texts, self.tok_info, config, seed,
-                                     bucket)
+                                     bucket,
+                                     splitter_params=self.splitter_params)
         if self.output_format == "txt":
             rows = materialize_rows(batch, config, self.tok_info, seed,
                                     (0x3A5C, bucket))
@@ -729,6 +740,30 @@ def run_sharded_pipeline(
     return written
 
 
+def train_splitter_params_from_corpus(corpus_paths, sample_bytes=1_500_000):
+    """Deterministic corpus sample (file-discovery order, first documents
+    up to ``sample_bytes``) -> punkt-trained SplitterParams. Every rank
+    computes the identical sample, so no coordination is needed."""
+    from .sentences import train_splitter_params
+    from .readers import split_id_text
+    texts = []
+    total = 0
+    for path in discover_source_files(corpus_paths):
+        with open(path, encoding="utf-8", errors="replace") as f:
+            for line in f:
+                _, text = split_id_text(line.rstrip("\n"))
+                if text.strip():
+                    texts.append(text)
+                    total += len(text)
+                if total >= sample_bytes:
+                    break
+        if total >= sample_bytes:
+            break
+    if not texts:
+        raise ValueError("splitter='learned': corpus sample is empty")
+    return train_splitter_params(texts)
+
+
 def run_bert_preprocess(
     corpus_paths,
     out_dir,
@@ -759,12 +794,15 @@ def run_bert_preprocess(
         raise ValueError("output_format must be parquet|txt")
     if bin_size is not None:
         binning_mod.num_bins(config.max_seq_length, bin_size)  # validate
+    splitter_params = (train_splitter_params_from_corpus(corpus_paths)
+                       if config.splitter == "learned" else None)
 
     return run_sharded_pipeline(
         corpus_paths,
         out_dir,
         BertBucketProcessor(tokenizer, config, seed, out_dir, bin_size,
-                            output_format),
+                            output_format,
+                            splitter_params=splitter_params),
         num_blocks=num_blocks,
         sample_ratio=sample_ratio,
         seed=seed,

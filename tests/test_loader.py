@@ -221,6 +221,35 @@ def test_process_workers_match_thread_workers(pipeline):
                 np.testing.assert_array_equal(x[key], y[key], err_msg=key)
 
 
+def test_process_mode_falls_back_on_single_core(pipeline, monkeypatch):
+    """On a single-core host, worker_mode='process' is a measured
+    pathology (LOADER_BENCH.json w4proc rows); the loader must fall back
+    to threads with a warning instead of running it — unless the
+    explicit force env (used by the process-mode correctness tests above)
+    is set."""
+    import os
+    import warnings
+    from lddl_tpu.loader.dataloader import DataLoader
+
+    monkeypatch.delenv("LDDL_TPU_FORCE_PROCESS_WORKERS", raising=False)
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    lt = _loader(pipeline, "dyn", num_workers=2)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        lp = _loader(pipeline, "dyn", num_workers=2, worker_mode="process")
+    assert any("falling back to thread" in str(w.message) for w in caught)
+    # Fallback means the THREAD path actually runs (no process pool) and
+    # batches are unchanged (stream purity).
+    assert lp._worker_mode == "thread"
+    for x, y in zip(list(lt), list(lp)):
+        for key in x:
+            np.testing.assert_array_equal(x[key], y[key], err_msg=key)
+
+    # >= 2 cores: process mode sticks.
+    monkeypatch.setattr(os, "cpu_count", lambda: 8)
+    assert DataLoader._check_process_mode(None) == "process"
+
+
 def test_process_worker_failure_surfaces(pipeline, tmp_path):
     """A dying worker process raises in the consumer, not a hang."""
     import pytest

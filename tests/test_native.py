@@ -291,6 +291,106 @@ def test_unassigned_codepoints_kept_like_hf(hf_tokenizer):
         pos += n
 
 
+@pytest.fixture(scope="module")
+def learned_params():
+    """Punkt params trained on a small English sample (needs nltk)."""
+    pytest.importorskip("nltk")
+    from lddl_tpu.preprocess.sentences import train_splitter_params
+    sample = (DOCS * 4) + [
+        "Mr. Smith met Dr. Jones. They agreed on No. 5. See Fig. 2 for "
+        "details. The U.S. delegation left. i.e. everyone went home.",
+        "The meeting ended. However, talks continued. If needed, see the "
+        "appendix. We adjourned at 5 p.m. sharp. This was expected.",
+    ] * 8
+    return train_splitter_params(sample)
+
+
+def test_learned_split_parity(learned_params):
+    """The C++ learned-splitter decision procedure matches the Python one
+    on real-ish docs AND on the static no-boundary edge cases."""
+    from lddl_tpu.preprocess.sentences import split_sentences_learned
+    blob = learned_params.serialize()
+    cases = DOCS + [
+        "", ".", "...", "a.", "a. b", "a. B", '"a." B said.',
+        "x!? Y", "e.g. something", "i.e. another", "No. 5 ranked",
+        "end.)  Next", "end.” Next", "A.B.C. Next",
+        "2. Grant of License. Subject to terms.",
+        "1999. The war ended.", "  10. Item ten. Done.",
+        "Version v. 2.0 shipped. Mr. J. R. Ewing agreed.",
+    ]
+    got = native.split_docs(cases, splitter_blob=blob)
+    for text, sents in zip(cases, got):
+        assert sents == split_sentences_learned(text, learned_params), \
+            repr(text)
+
+
+def test_learned_split_fuzz_parity(learned_params):
+    """Random unicode soup + sentence-ish punctuation: python and C++
+    learned splitters agree byte-for-byte."""
+    import numpy as np
+    from lddl_tpu.preprocess.sentences import split_sentences_learned
+    g = np.random.default_rng(23)
+    blob = learned_params.serialize()
+    vocab_words = ["mr", "dr", "No", "fig", "The", "they", "agreed",
+                   "église", "café", "ẞig", "Iİı", "中文", "a", "B.",
+                   "2.0", "3", "10", "...", "v.", "p.m", "(so)", '"q"',
+                   "-x-", "##number##", "İstanbul",
+                   # Greek final-sigma contexts: CPython lowers word-final
+                   # U+03A3 to ς (context rule), which the C++ port must
+                   # replicate for type equality against trained params.
+                   "ΟΔΟΣ", "ΟΔΟΣ.", "ΣΟΦΙΑ", "Σ.", "ΑΣ'Σ", "abΣ"]
+    puncts = [". ", "! ", "? ", ".  ", ".\t", ". “Next", " ", ", "]
+    docs = []
+    for _ in range(150):
+        parts = []
+        for _ in range(int(g.integers(3, 25))):
+            parts.append(vocab_words[int(g.integers(0, len(vocab_words)))])
+            parts.append(puncts[int(g.integers(0, len(puncts)))])
+        docs.append("".join(parts))
+    got = native.split_docs(docs, splitter_blob=blob)
+    for text, sents in zip(docs, got):
+        assert sents == split_sentences_learned(text, learned_params), \
+            repr(text)
+
+
+def test_learned_e2e_engine_parity(hf_tokenizer, learned_params, tmp_path):
+    """splitter='learned' end-to-end: native and hf tokenizer engines
+    produce byte-identical shards (the learned decision runs in C++ on
+    one path and in Python on the other)."""
+    import json
+    import os
+    from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
+
+    corpus = tmp_path / "corpus" / "source"
+    corpus.mkdir(parents=True)
+    with open(corpus / "0.txt", "w", encoding="utf-8") as f:
+        for i, d in enumerate(DOCS * 3):
+            if d.strip():
+                f.write("doc-{} {}\n".format(i, d))
+
+    hashes = {}
+    for eng in ("native", "hf"):
+        out = tmp_path / ("out_" + eng)
+        run_bert_preprocess(
+            {"wikipedia": str(tmp_path / "corpus")}, str(out), hf_tokenizer,
+            config=BertPretrainConfig(max_seq_length=32, masking=True,
+                                      tokenizer_engine=eng,
+                                      splitter="learned"),
+            num_blocks=4, sample_ratio=1.0, seed=777, bin_size=8)
+        import hashlib
+        digest = {}
+        for name in sorted(os.listdir(out)):
+            if "parquet" in name:
+                import pyarrow.parquet as pq
+                t = pq.read_table(os.path.join(out, name))
+                digest[name] = hashlib.sha256(
+                    json.dumps(t.to_pydict(), sort_keys=True,
+                               default=str).encode()).hexdigest()
+        hashes[eng] = digest
+    assert hashes["native"] == hashes["hf"]
+    assert any(hashes["native"])
+
+
 def test_fuzz_unicode_parity_vs_hf(hf_tokenizer):
     """Random unicode soup (all planes, no surrogates) tokenizes
     identically to BertTokenizerFast."""
