@@ -149,9 +149,11 @@ def main():
                     num_heads=4, intermediate_size=128)
     else:
         # bert_large @ L=512 is the reference's own headline pretraining
-        # config (phase2); base @ 2048 exercises the long-context story.
-        configs = [("bert_base", 16, 512), ("bert_base", 4, 2048),
-                   ("bert_large", 8, 512)]
+        # config (phase2); base @ 1024 pins the auto-selection crossover
+        # (attention.resolve_auto_impl flips to flash at L >= 1024); base
+        # @ 2048 exercises the long-context story.
+        configs = [("bert_base", 16, 512), ("bert_base", 8, 1024),
+                   ("bert_base", 4, 2048), ("bert_large", 8, 512)]
         base = {}
 
     results = []
