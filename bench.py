@@ -115,7 +115,8 @@ def make_corpus(out_root, target_mb, shards=4, seed=0, n_types=30000,
 
 
 def _timed_run(corpus_dir, corpus_bytes, out_dir, tokenizer, *,
-               tokenizer_engine, mask_engine, num_workers, num_blocks=None):
+               tokenizer_engine, mask_engine, num_workers, num_blocks=None,
+               splitter="rules"):
     if num_blocks is None:
         num_blocks = max(8, 2 * (num_workers or 1))
     from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
@@ -126,7 +127,8 @@ def _timed_run(corpus_dir, corpus_bytes, out_dir, tokenizer, *,
         tokenizer,
         config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
                                   masking=True, engine=mask_engine,
-                                  tokenizer_engine=tokenizer_engine),
+                                  tokenizer_engine=tokenizer_engine,
+                                  splitter=splitter),
         num_blocks=num_blocks,
         sample_ratio=1.0,
         seed=12345,
@@ -214,13 +216,17 @@ def main():
         value = max(runs)
 
         variants = {}
-        for name, tok_eng, mask_eng, n_workers in (
-                ("native+numpy", "auto", "numpy", workers),
-                ("hf+numpy", "hf", "numpy", workers),
+        for name, tok_eng, mask_eng, n_workers, splitter in (
+                ("native+numpy", "auto", "numpy", workers, "rules"),
+                ("hf+numpy", "hf", "numpy", workers, "rules"),
+                # punkt-grade segmentation end-to-end (corpus-trained
+                # params; includes the per-run punkt training cost).
+                ("native+learned_splitter", "auto", "numpy", workers,
+                 "learned"),
                 # jax variant runs single-process: N pool workers sharing
                 # one chip is pathological, so give it its best case
                 # (still loses - see MASK_ENGINE_BENCH.json).
-                ("native+jax_mask_w1", "auto", "jax", 1),
+                ("native+jax_mask_w1", "auto", "jax", 1, "rules"),
         ):
             try:
                 v, _ = _timed_run(
@@ -228,7 +234,7 @@ def main():
                     os.path.join(tmp, "out_" + name.replace("+", "_")),
                     tokenizer, tokenizer_engine=tok_eng, mask_engine=mask_eng,
                     num_workers=n_workers,
-                    num_blocks=max(8, 2 * workers))
+                    num_blocks=max(8, 2 * workers), splitter=splitter)
                 variants[name] = round(v, 4)
             except Exception as e:  # variant failure must not kill the bench
                 variants[name] = "error: {}".format(e)

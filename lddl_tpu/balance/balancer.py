@@ -37,6 +37,8 @@ import os
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from ..preprocess.binning import DEFAULT_PARQUET_COMPRESSION
+
 from ..parallel.distributed import LocalCommunicator
 from ..utils.fs import (
     get_all_bin_ids,
@@ -98,7 +100,8 @@ class _Shard:
         self._count("rows_written", num_samples)
         if table is not None:
             assert table.num_rows == num_samples
-            pq.write_table(table, path)
+            pq.write_table(table, path,
+                           compression=DEFAULT_PARQUET_COMPRESSION)
 
     def _load(self, num_samples, with_table):
         """Remove rows, consuming input files from the end first, then
@@ -161,7 +164,8 @@ class _Shard:
         if i_am_owner:
             table = pa.concat_tables([pq.read_table(f.path) for f in sources])
             assert table.num_rows == n
-            pq.write_table(table, self.out_path)
+            pq.write_table(table, self.out_path,
+                       compression=DEFAULT_PARQUET_COMPRESSION)
             for f in parts:
                 os.remove(f.path)
         self.final_file = File(self.out_path, n)

@@ -21,6 +21,7 @@ import pyarrow as pa
 import pyarrow.parquet as pq
 
 from ..utils import rng as lrng
+from .binning import DEFAULT_PARQUET_COMPRESSION
 from .sentences import split_sentences, split_sentences_learned
 from .runner import run_sharded_pipeline
 
@@ -85,7 +86,8 @@ class BartBucketProcessor:
         from .runner import processor_fingerprint, splitter_digest
         return processor_fingerprint(type(self).__name__, self.config,
                                      self.seed, self.output_format,
-                                     splitter_digest(self.splitter_params))
+                                     splitter_digest(self.splitter_params),
+                                     "codec=" + DEFAULT_PARQUET_COMPRESSION)
 
     def __call__(self, texts, bucket):
         g = lrng.sample_rng(self.seed, 0xBA27, bucket)
@@ -105,7 +107,8 @@ class BartBucketProcessor:
         path = os.path.join(self.out_dir, "part.{}.parquet".format(bucket))
         table = pa.table({"sentences": rows},
                          schema=pa.schema([("sentences", pa.string())]))
-        pq.write_table(table, path)
+        pq.write_table(table, path,
+                       compression=DEFAULT_PARQUET_COMPRESSION)
         return {path: len(rows)}
 
 

@@ -58,9 +58,15 @@ def make_schema(masking=False, binned=False):
     return pa.schema(list(fields.items()))
 
 
+# One fact for the shard sink codec (binning, balancer, BART all import
+# it; it also feeds the resume fingerprints): lz4 measured write -28% /
+# read -66% vs snappy at +8% size — see the README attribution note.
+DEFAULT_PARQUET_COMPRESSION = "lz4"
+
+
 def write_shard_columns(columns, n, out_dir, part_id, masking=False,
                         bin_size=None, target_seq_length=128,
-                        compression="snappy"):
+                        compression=DEFAULT_PARQUET_COMPRESSION):
     """Write one block's COLUMNS ({name: list-or-ndarray}) as
     part.<part_id>.parquet[_<bin>] files — the columnar fast path (no
     per-row dicts anywhere between sample construction and arrow).
