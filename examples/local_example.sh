@@ -35,6 +35,8 @@ build_wordpiece_vocab(texts, sys.argv[1] + "/vocab.txt", vocab_size=8192)
 EOF
 
 echo "== 3. preprocess (binned, static masking) =="
+# add "--splitter learned" for punkt-grade segmentation (corpus-trained
+# parameters; see SPLITTER_DRIFT.json — F1 0.99 vs punkt)
 python -m lddl_tpu.cli.preprocess_bert_pretrain \
   --wikipedia "$DATA/wiki" \
   --sink "$DATA/pre" \
