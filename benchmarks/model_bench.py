@@ -57,6 +57,72 @@ def matmul_flops_per_step(cfg, batch, seq_len, n_pred=None):
     return 3 * per_row_fwd * batch
 
 
+def bart_matmul_flops_per_step(cfg, batch, seq_len):
+    """BART denoising train-step matmul FLOPs (enc + dec self/cross + LM
+    head over ALL decoder positions — denoising reconstructs every token,
+    so the BERT-style masked-position gather does not apply)."""
+    h, ffn = cfg.hidden_size, cfg.intermediate_size
+    e = ld = seq_len
+    enc = cfg.num_encoder_layers * (8 * h * h + 4 * h * ffn + 4 * e * h) * e
+    dec_self = cfg.num_decoder_layers * (8 * h * h + 4 * ld * h) * ld
+    dec_cross = cfg.num_decoder_layers * (
+        (4 * h * h + 4 * e * h) * ld      # q/out projections + attention
+        + 4 * h * h * e)                  # k/v projections over enc out
+    dec_ffn = cfg.num_decoder_layers * 4 * h * ffn * ld
+    head = 2 * h * cfg.vocab_size * ld
+    return 3 * batch * (enc + dec_self + dec_cross + dec_ffn + head)
+
+
+def bench_bart(mesh, batch, seq_len, n_steps, reps, peak_flops):
+    """One BART row: same multi-step scan method as the BERT rows."""
+    import jax
+    from lddl_tpu.loader import to_device_step_batches
+    from lddl_tpu.models import create_train_state, make_sharded_multi_step
+    from lddl_tpu.models.bart import (BartConfig, BartForPreTraining,
+                                      bart_batch_loss)
+    from lddl_tpu.models.testing import fake_bart_batch
+    from lddl_tpu.models.train import make_optimizer
+
+    cfg = BartConfig.bart_base(attention_dropout=0.0)
+    model = BartForPreTraining(cfg)
+    batches = [fake_bart_batch(cfg.vocab_size, batch, seq_len, seed=2000 + i)
+               for i in range(n_steps)]
+    stacked_np = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    state, _ = create_train_state(
+        cfg, mesh, batches[0], model=model,
+        optimizer=make_optimizer(warmup_steps=10,
+                                 total_steps=n_steps * (reps + 1) + 10))
+    multi = make_sharded_multi_step(mesh, cfg, n_steps, model=model,
+                                    batch_loss=bart_batch_loss)
+    stacked = to_device_step_batches(stacked_np, mesh)
+    state, metrics = multi(state, stacked, seed=0)
+    first_loss = float(np.asarray(metrics["loss"])[0])
+    t0 = time.perf_counter()
+    for r in range(reps):
+        state, metrics = multi(state, stacked, seed=r + 1)
+    last_loss = float(np.asarray(metrics["loss"])[-1])  # readback = sync
+    elapsed = time.perf_counter() - t0
+    step_s = elapsed / (reps * n_steps)
+    flops = bart_matmul_flops_per_step(cfg, batch, seq_len)
+    row = {
+        "model": "bart_base",
+        "attention_impl": cfg.attention_impl,
+        "batch": batch,
+        "seq_len": seq_len,
+        "n_steps_per_dispatch": n_steps,
+        "timed_steps": reps * n_steps,
+        "step_ms": round(step_s * 1e3, 3),
+        "tokens_per_s": round(batch * seq_len / step_s, 1),
+        "model_tflops_per_step": round(flops / 1e12, 3),
+        "mfu": round(flops / step_s / peak_flops, 4) if peak_flops else None,
+        "first_loss": round(first_loss, 4),
+        "last_loss": round(last_loss, 4),
+    }
+    assert np.isfinite(first_loss) and np.isfinite(last_loss), row
+    del state, metrics, stacked
+    return row
+
+
 def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
     import jax
     from lddl_tpu.loader import to_device_step_batches
@@ -182,6 +248,17 @@ def main():
             row["model"] = family
             print(row, flush=True)
             results.append(row)
+
+    if not args.quick:
+        # The second model family: BART denoising (encoder-decoder) at the
+        # reference BART preprocessor's target length scale.
+        try:
+            row = bench_bart(mesh, 16, 512, n_steps, reps, peak_flops)
+        except Exception as e:
+            row = {"model": "bart_base", "batch": 16, "seq_len": 512,
+                   "error": "{}: {}".format(type(e).__name__, str(e)[:300])}
+        print(row, flush=True)
+        results.append(row)
 
     payload = {
         "device": str(device),
