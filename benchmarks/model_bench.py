@@ -57,6 +57,43 @@ def matmul_flops_per_step(cfg, batch, seq_len, n_pred=None):
     return 3 * per_row_fwd * batch
 
 
+def _run_multi_step(mesh, cfg, batches, n_steps, reps, model=None,
+                    batch_loss=None):
+    """Shared timing skeleton for every row: build state, compile+warm one
+    multi-step dispatch, time ``reps`` more. Synchronization is a host
+    readback of the last loss (block_until_ready is not a reliable
+    barrier on the tunneled runtime). Returns
+    (step_s, first_loss, last_loss, warmup_s)."""
+    from lddl_tpu.loader import to_device_step_batches
+    from lddl_tpu.models import create_train_state, make_sharded_multi_step
+    from lddl_tpu.models.train import make_optimizer
+
+    stacked_np = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
+    state, _ = create_train_state(
+        cfg, mesh, batches[0], model=model,
+        optimizer=make_optimizer(warmup_steps=10,
+                                 total_steps=n_steps * (reps + 1) + 10))
+    multi = make_sharded_multi_step(mesh, cfg, n_steps, model=model,
+                                    batch_loss=batch_loss)
+    stacked = to_device_step_batches(stacked_np, mesh)
+
+    t0 = time.perf_counter()
+    state, metrics = multi(state, stacked, seed=0)
+    first_loss = float(np.asarray(metrics["loss"])[0])  # readback = sync
+    warmup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for r in range(reps):
+        state, metrics = multi(state, stacked, seed=r + 1)
+    last_loss = float(np.asarray(metrics["loss"])[-1])
+    elapsed = time.perf_counter() - t0
+    assert np.isfinite(first_loss) and np.isfinite(last_loss), \
+        (first_loss, last_loss)
+    # Free the donated-state chain before the next config compiles.
+    del state, metrics, stacked
+    return elapsed / (reps * n_steps), first_loss, last_loss, warmup_s
+
+
 def bart_matmul_flops_per_step(cfg, batch, seq_len):
     """BART denoising train-step matmul FLOPs (enc + dec self/cross + LM
     head over ALL decoder positions — denoising reconstructs every token,
@@ -75,38 +112,26 @@ def bart_matmul_flops_per_step(cfg, batch, seq_len):
 
 def bench_bart(mesh, batch, seq_len, n_steps, reps, peak_flops):
     """One BART row: same multi-step scan method as the BERT rows."""
-    import jax
-    from lddl_tpu.loader import to_device_step_batches
-    from lddl_tpu.models import create_train_state, make_sharded_multi_step
     from lddl_tpu.models.bart import (BartConfig, BartForPreTraining,
                                       bart_batch_loss)
     from lddl_tpu.models.testing import fake_bart_batch
-    from lddl_tpu.models.train import make_optimizer
+
+    from lddl_tpu.models.attention import resolve_auto_impl
 
     cfg = BartConfig.bart_base(attention_dropout=0.0)
-    model = BartForPreTraining(cfg)
     batches = [fake_bart_batch(cfg.vocab_size, batch, seq_len, seed=2000 + i)
                for i in range(n_steps)]
-    stacked_np = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
-    state, _ = create_train_state(
-        cfg, mesh, batches[0], model=model,
-        optimizer=make_optimizer(warmup_steps=10,
-                                 total_steps=n_steps * (reps + 1) + 10))
-    multi = make_sharded_multi_step(mesh, cfg, n_steps, model=model,
-                                    batch_loss=bart_batch_loss)
-    stacked = to_device_step_batches(stacked_np, mesh)
-    state, metrics = multi(state, stacked, seed=0)
-    first_loss = float(np.asarray(metrics["loss"])[0])
-    t0 = time.perf_counter()
-    for r in range(reps):
-        state, metrics = multi(state, stacked, seed=r + 1)
-    last_loss = float(np.asarray(metrics["loss"])[-1])  # readback = sync
-    elapsed = time.perf_counter() - t0
-    step_s = elapsed / (reps * n_steps)
+    step_s, first_loss, last_loss, warmup_s = _run_multi_step(
+        mesh, cfg, batches, n_steps, reps, model=BartForPreTraining(cfg),
+        batch_loss=bart_batch_loss)
     flops = bart_matmul_flops_per_step(cfg, batch, seq_len)
-    row = {
+    return {
         "model": "bart_base",
-        "attention_impl": cfg.attention_impl,
+        # record the CONCRETE impl auto resolves to at this length (the
+        # encoder's bidirectional self-attention; decoder/cross are
+        # always dense), like the explicit dense/flash BERT rows
+        "attention_impl": resolve_auto_impl(seq_len, True,
+                                            cfg.attention_dropout),
         "batch": batch,
         "seq_len": seq_len,
         "n_steps_per_dispatch": n_steps,
@@ -117,54 +142,26 @@ def bench_bart(mesh, batch, seq_len, n_steps, reps, peak_flops):
         "mfu": round(flops / step_s / peak_flops, 4) if peak_flops else None,
         "first_loss": round(first_loss, 4),
         "last_loss": round(last_loss, 4),
+        "warmup_dispatch_s": round(warmup_s, 2),
     }
-    assert np.isfinite(first_loss) and np.isfinite(last_loss), row
-    del state, metrics, stacked
-    return row
 
 
 def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
-    import jax
-    from lddl_tpu.loader import to_device_step_batches
-    from lddl_tpu.models import create_train_state, make_sharded_multi_step
     from lddl_tpu.models.testing import fake_pretrain_batch
-    from lddl_tpu.models.train import make_optimizer
+    from lddl_tpu.models.train import mlm_gather_cap
 
     batches = [fake_pretrain_batch(cfg.vocab_size, batch, seq_len,
                                    seed=1000 + i, segment_split=True)
                for i in range(n_steps)]
-    stacked_np = {k: np.stack([b[k] for b in batches]) for k in batches[0]}
-
-    from lddl_tpu.models.train import mlm_gather_cap
     n_pred = (mlm_gather_cap(seq_len)
               if getattr(cfg, "mlm_gather", False) else None)
     if n_pred is not None and n_pred >= seq_len:
         n_pred = None
 
-    state, _ = create_train_state(
-        cfg, mesh, batches[0],
-        optimizer=make_optimizer(warmup_steps=10,
-                                 total_steps=n_steps * (reps + 1) + 10))
-    multi = make_sharded_multi_step(mesh, cfg, n_steps)
-    stacked = to_device_step_batches(stacked_np, mesh)
-
-    # Warmup dispatch: compile + first run.
-    t0 = time.perf_counter()
-    state, metrics = multi(state, stacked, seed=0)
-    jax.block_until_ready(metrics)
-    warmup_s = time.perf_counter() - t0
-    first_loss = float(np.asarray(metrics["loss"])[0])
-
-    t0 = time.perf_counter()
-    for r in range(reps):
-        state, metrics = multi(state, stacked, seed=r + 1)
-    jax.block_until_ready(metrics)
-    elapsed = time.perf_counter() - t0
-
-    last_loss = float(np.asarray(metrics["loss"])[-1])
-    step_s = elapsed / (reps * n_steps)
+    step_s, first_loss, last_loss, warmup_s = _run_multi_step(
+        mesh, cfg, batches, n_steps, reps)
     flops = matmul_flops_per_step(cfg, batch, seq_len, n_pred)
-    row = {
+    return {
         "attention_impl": cfg.attention_impl,
         "batch": batch,
         "seq_len": seq_len,
@@ -180,10 +177,6 @@ def bench_config(mesh, cfg, batch, seq_len, n_steps, reps, peak_flops):
         "last_loss": round(last_loss, 4),
         "warmup_dispatch_s": round(warmup_s, 2),
     }
-    assert np.isfinite(first_loss) and np.isfinite(last_loss), row
-    # Free the donated-state chain before the next config compiles.
-    del state, metrics, stacked
-    return row
 
 
 def main():
@@ -265,16 +258,19 @@ def main():
         "device_kind": kind,
         "peak_bf16_tflops": peak,
         "model": ("tiny surrogates" if args.quick
-                  else "per-row (bert_base + bert_large)"),
+                  else "per-row (bert_base + bert_large + bart_base)"),
         "method": ("each timed dispatch = {} optimizer steps in one jitted "
                    "lax.scan (make_sharded_multi_step); per-step time = "
                    "wall / ({}x{}); MFU = matmul-FLOPs / step_time / "
                    "peak_bf16".format(n_steps, reps, n_steps)),
         "results": results,
     }
-    with open(os.path.join(ROOT, "MODEL_BENCH.json"), "w") as f:
+    # --quick is a harness smoke test: never clobber the recorded
+    # real-chip artifact with tiny-surrogate rows.
+    name = "MODEL_BENCH_QUICK.json" if args.quick else "MODEL_BENCH.json"
+    with open(os.path.join(ROOT, name), "w") as f:
         json.dump(payload, f, indent=1)
-    print("wrote MODEL_BENCH.json")
+    print("wrote " + name)
 
 
 if __name__ == "__main__":
