@@ -20,7 +20,8 @@ import flax.linen as nn
 with_logical = nn.with_logical_constraint
 
 
-def resolve_auto_impl(seq_len, blockwise_ok, attention_dropout):
+def resolve_auto_impl(seq_len, blockwise_ok, attention_dropout,
+                      deterministic=False):
     """attention_impl="auto" -> "flash"|"dense" (measured selection,
     MODEL_BENCH.json): the pallas flash kernel wins where attention
     dominates (L >= ~1024 — 33.9% vs 27.0% MFU at L=2048, round 4) but
@@ -28,10 +29,12 @@ def resolve_auto_impl(seq_len, blockwise_ok, attention_dropout):
     per-layer layout transposes (XLA's dense attention fuses into the
     surrounding ops; the kernel's [B*H, L, D] relayout does not). Flash
     is picked only when it computes the SAME math as dense (it skips
-    attention-prob dropout, so dropout > 0 pins dense): auto never
-    changes the trained model, only the speed."""
+    attention-prob dropout, so dropout > 0 pins dense — unless the call
+    is deterministic, where dropout is a no-op and flash is identical):
+    auto never changes the trained model, only the speed."""
+    effective_dropout = 0.0 if deterministic else attention_dropout
     return ("flash" if blockwise_ok and seq_len >= 1024
-            and attention_dropout == 0.0 else "dense")
+            and effective_dropout == 0.0 else "dense")
 
 
 class MultiHeadAttention(nn.Module):
@@ -79,7 +82,7 @@ class MultiHeadAttention(nn.Module):
         impl = self.attention_impl
         if impl == "auto":
             impl = resolve_auto_impl(q_input.shape[1], blockwise_ok,
-                                     self.dropout)
+                                     self.dropout, deterministic)
         use_ring = False
         if impl == "ring" and blockwise_ok:
             from jax.sharding import get_abstract_mesh
@@ -114,7 +117,7 @@ class MultiHeadAttention(nn.Module):
             k = split_heads(proj("key")(kv_input), None)
             v = split_heads(proj("value")(kv_input), None)
             if segments is not None:
-                ctx = flash_attention(q, k, v, segments, q_mask=segments)
+                ctx = flash_attention(q, k, v, segments=segments)
             else:
                 ctx = flash_attention(q, k, v, padding_mask)
         else:

@@ -383,10 +383,26 @@ def make_eval_step(mesh, config, model=None, ignore_index=-1,
         return metrics
 
     jitted = jax.jit(step_fn)
+    warned = [False]
 
     def wrapped(params, batch):
         with jax.set_mesh(mesh), nn.logical_axis_rules(
                 axis_rules_for(mesh)):
-            return jitted(params, batch)
+            metrics = jitted(params, batch)
+        # Train steps meter mlm_dropped_labels and tolerate the 4-sigma
+        # cap; EVAL numbers are quoted as exact, so a capped row must be
+        # loud (ADVICE r4). The host read costs one tiny-scalar sync per
+        # eval step — eval callers read the metrics anyway.
+        if not warned[0] and "mlm_dropped_labels" in metrics:
+            if int(metrics["mlm_dropped_labels"]) > 0:
+                warned[0] = True
+                import warnings
+                warnings.warn(
+                    "mlm_gather dropped masked labels in an eval step: the "
+                    "reported loss excludes them. Labels exceeded the "
+                    "4-sigma cap (mlm_gather_cap); evaluate with "
+                    "config.mlm_gather=False for exact loss.",
+                    RuntimeWarning, stacklevel=2)
+        return metrics
 
     return wrapped

@@ -185,11 +185,12 @@ class NativeTokenizer:
 
     def set_splitter(self, blob):
         """Attach (or clear, blob=None) corpus-learned punkt splitter
-        params — the SplitterParams.serialize() blob. tokenize_docs then
-        splits with the learned decision procedure."""
-        blob = blob or b""
-        self._lib.lddl_tok_set_splitter(self._handle, blob, len(blob))
-        self._args = self._args[:4] + (blob or None,)
+        params — the SplitterParams.serialize() blob (never empty: it
+        carries a 'P1' header line, so clear-vs-params is unambiguous).
+        tokenize_docs then splits with the learned decision procedure."""
+        self._lib.lddl_tok_set_splitter(self._handle, blob or b"",
+                                        len(blob or b""))
+        self._args = self._args[:4] + (blob,)
 
     def __reduce__(self):
         # ctypes handles cannot cross pickle boundaries; rebuild from the

@@ -349,8 +349,24 @@ def _build_vjp():
     return fa
 
 
-def flash_attention(q, k, v, kv_mask, interpret=None, q_mask=None):
+def flash_attention(q, k, v, kv_mask=None, interpret=None, q_mask=None,
+                    segments=None):
     """Differentiable fused attention: pallas forward AND backward (see
-    module docstring). For packed rows pass per-token segment ids as BOTH
-    kv_mask and q_mask — attention becomes block-diagonal per segment."""
+    module docstring).
+
+    ``kv_mask`` [B, L] is strictly a BINARY key-padding mask (1 = attend);
+    any nonzero value normalizes to 1. For packed rows pass per-token
+    segment ids as ``segments=`` — it sets both sides and attention
+    becomes block-diagonal per segment (0 = padding). Passing segment ids
+    as ``kv_mask`` alone would silently attend across packed segments
+    (ADVICE r4), which is why the packed path has its own keyword;
+    ``q_mask`` stays for callers composing the two sides explicitly."""
+    if segments is not None:
+        if kv_mask is not None or q_mask is not None:
+            raise ValueError(
+                "segments= is exclusive with kv_mask/q_mask: it defines "
+                "both sides of the block-diagonal mask")
+        kv_mask, q_mask = segments, segments
+    elif kv_mask is None:
+        raise ValueError("flash_attention needs kv_mask or segments")
     return _build_vjp()(q, k, v, kv_mask, q_mask, interpret)

@@ -25,6 +25,8 @@ lddl/dask/bert/pretrain.py:451-471):
 """
 
 import dataclasses
+import hashlib
+import json
 
 import numpy as np
 
@@ -156,6 +158,22 @@ class TokenizerInfo:
             raise AttributeError(name)
         self.__init__(tok)
         return getattr(self, name)
+
+    @property
+    def vocab_digest(self):
+        """Digest of the id->token snapshot this object tokenizes with.
+        Cached on self: TokenizerInfo is rebuilt per process (and after
+        every unpickle), so the cache cannot go stale against its own
+        tables — unlike a digest cached on the mutable tokenizer (the
+        round-4 size-keyed memo missed same-size in-place token swaps)."""
+        d = self.__dict__.get("_vocab_digest")
+        if d is None:
+            h = hashlib.sha256()
+            h.update(b"1" if self.do_lower_case else b"0")
+            h.update(json.dumps(self.token_list,
+                                separators=(",", ":")).encode())
+            d = self._vocab_digest = h.hexdigest()[:16]
+        return d
 
     def join(self, ids):
         return " ".join(self.id_to_token[np.asarray(ids, dtype=np.int64)])

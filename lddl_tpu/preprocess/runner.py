@@ -389,23 +389,13 @@ class BertBucketProcessor:
         for the resume manifest: resuming with a different vocab, seed,
         bin width, masking config or sink format would silently mix shards
         from two incompatible runs (ADVICE round 3)."""
-        # get_vocab() costs ~40 ms on a 30k vocab; memoize the digest on
-        # the tokenizer object, keyed by the current vocab SIZE so the
-        # realistic mutation (add_tokens between runs) invalidates it —
-        # a same-size vocab swap would still hit the stale cache, but
-        # that requires deliberately replacing tokens in place.
-        size = len(self.tokenizer)
-        cached = getattr(self.tokenizer, "_lddl_tpu_vocab_digest", None)
-        if cached is not None and cached[0] == size:
-            vocab = cached[1]
-        else:
-            vocab = hashlib.sha256(json.dumps(
-                sorted(self.tokenizer.get_vocab().items()),
-                separators=(",", ":")).encode()).hexdigest()[:16]
-            try:
-                self.tokenizer._lddl_tpu_vocab_digest = (size, vocab)
-            except AttributeError:
-                pass
+        # The digest hashes the id->token table the pipeline actually
+        # tokenizes with (TokenizerInfo's construction-time snapshot), so
+        # ANY vocab difference — including a same-size in-place token swap
+        # — changes it. Memoized on the TokenizerInfo, which is rebuilt
+        # per process/run, so the cache can never outlive the snapshot it
+        # hashed (round-4 VERDICT: the old size-keyed cache could).
+        vocab = self.tok_info.vocab_digest
         return processor_fingerprint(type(self).__name__, vocab, self.config,
                                      self.seed, self.bin_size,
                                      self.output_format,

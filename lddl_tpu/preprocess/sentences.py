@@ -113,9 +113,13 @@ class SplitterParams:
 
     def serialize(self):
         """Line-oriented UTF-8 blob for the native engine (and for the
-        fingerprint): 'A <abbr>' / 'C <t1> <t2>' / 'S <starter>' /
-        'O <type> <flags>' lines, sorted for determinism."""
-        lines = []
+        fingerprint): a 'P1' version header, then 'A <abbr>' /
+        'C <t1> <t2>' / 'S <starter>' / 'O <type> <flags>' lines, sorted
+        for determinism. The header makes even an empty-but-valid params
+        object serialize non-empty, so the native engine cannot confuse
+        it with "no params = rules splitter" (ADVICE r4); the C++ parser
+        skips unknown tags, so 'P1' needs no native-side handling."""
+        lines = ["P1"]
         for a in sorted(self.abbrev_types):
             lines.append("A " + a)
         for t1, t2 in sorted(self.collocations):

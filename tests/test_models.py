@@ -144,6 +144,10 @@ def test_attention_auto_selection(tiny_cfg):
     assert resolve_auto_impl(2048, True, 0.0) == "flash"
     assert resolve_auto_impl(2048, True, 0.1) == "dense"  # prob dropout
     assert resolve_auto_impl(2048, False, 0.0) == "dense"  # causal/cross
+    # deterministic (eval): dropout is a no-op, so flash is identical math
+    # and auto may pick it even with attention_dropout > 0 (ADVICE r4).
+    assert resolve_auto_impl(2048, True, 0.1, deterministic=True) == "flash"
+    assert resolve_auto_impl(512, True, 0.1, deterministic=True) == "dense"
     assert BertConfig.tiny().attention_impl == "auto"
 
     batch = _fake_batch(tiny_cfg, B=4, L=64, seed=9)

@@ -162,7 +162,10 @@ def test_packed_flash_matches_packed_dense(packed_setup):
     seg[1, :128] = 1
     seg = jnp.asarray(seg)
 
-    out_flash = flash_attention(q, k, v, seg, q_mask=seg)
+    out_flash = flash_attention(q, k, v, segments=seg)
+    # segments= defines both mask sides; mixing it with either is an error.
+    with pytest.raises(ValueError, match="exclusive"):
+        flash_attention(q, k, v, seg, segments=seg)
 
     # Dense reference with an explicit block-diagonal mask, per batch row.
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)

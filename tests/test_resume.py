@@ -222,6 +222,52 @@ def test_resume_refuses_changed_corpus_or_processor_config(fixture_dirs,
                              resume=True, **_RUN_KW)
 
 
+def test_resume_refuses_same_size_vocab_swap(fixture_dirs, tmp_path):
+    """A same-size in-place token swap must refuse resume (VERDICT r4:
+    the old digest memo was keyed by vocab SIZE on the mutable tokenizer
+    object, so exactly this mutation hit a stale cache). The digest now
+    hashes the TokenizerInfo snapshot, so any content change refuses."""
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    from lddl_tpu.preprocess.runner import BertBucketProcessor
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "never.flag")
+    proc = _FailOnce(_bert_processor(vocab, out), [3], flag)
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc, **_RUN_KW)
+
+    # Same vocab SIZE, one ordinary token replaced in place.
+    with open(vocab) as f:
+        tokens = f.read().splitlines()
+    swap_at = max(i for i, t in enumerate(tokens)
+                  if not (t.startswith("[") and t.endswith("]")))
+    tokens[swap_at] = "swappedtoken"
+    swapped = str(tmp_path / "vocab_swapped.txt")
+    with open(swapped, "w") as f:
+        f.write("\n".join(tokens) + "\n")
+    tok = get_tokenizer(vocab_file=swapped)
+    assert len(tok) == len(get_tokenizer(vocab_file=vocab))
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True)
+    reproc = BertBucketProcessor(tok, cfg, 4242, out, 8, "parquet")
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, reproc,
+                             resume=True, **_RUN_KW)
+
+
+def test_vocab_digest_ignores_stale_tokenizer_cache(fixture_dirs, tmp_path):
+    """Guard against regressing to the round-4 scheme: a digest cached on
+    the tokenizer OBJECT can outlive an in-place vocab mutation. The
+    fingerprint must derive from the TokenizerInfo snapshot and ignore
+    any attribute planted on the tokenizer."""
+    td, corpus, vocab = fixture_dirs
+    proc = _bert_processor(vocab, str(tmp_path / "o1"))
+    fp = proc.fingerprint()
+    # Plant a stale same-size cache entry where the old code kept it.
+    proc.tokenizer._lddl_tpu_vocab_digest = (len(proc.tokenizer),
+                                             "deadbeefdeadbeef")
+    assert proc.fingerprint() == fp
+
+
 def test_fresh_dir_refuses_without_resume(fixture_dirs, tmp_path):
     td, corpus, vocab = fixture_dirs
     out = str(tmp_path / "out")
