@@ -96,7 +96,7 @@ class Embeddings(nn.Module):
         pos = nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), (None, "embed")),
+                _dense_init(cfg), ("embed_vocab", None)),
             name="positions")(jnp.arange(input_ids.shape[1])[None, :])
         x = with_logical(x + pos, ("batch", "seq", "act_embed"))
         x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, dtype=cfg.dtype,
@@ -168,10 +168,13 @@ class BartForPreTraining(nn.Module):
     def __call__(self, input_ids, attention_mask, decoder_input_ids,
                  deterministic=True):
         cfg = self.cfg
+        # Rows on fsdp, embed dim replicated — same rationale as the BERT
+        # Embeddings tables (gather outputs must come out (batch, seq)-
+        # sharded, not embed-sharded; see bert.LOGICAL_AXIS_RULES).
         token_embed = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("vocab", "embed")),
+                _dense_init(cfg), ("embed_vocab", None)),
             name="shared_embeddings")
 
         enc_cls = (nn.remat(EncoderLayer, static_argnums=(3,))

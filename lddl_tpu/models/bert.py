@@ -43,6 +43,14 @@ LOGICAL_AXIS_RULES = (
     ("heads", "tp"),
     ("kv", None),
     ("vocab", "tp"),
+    # Embedding-table ROWS (the token-id dim of nn.Embed tables) shard
+    # over fsdp only — "vocab"(tp) there would make every step all-gather
+    # the table across tp AND leave the gather output embed-sharded, which
+    # XLA can only reshard to (batch, seq) via involuntary full
+    # rematerialization (MULTICHIP_r04 warnings). With rows on fsdp the
+    # table joins the normal ZeRO just-in-time param gather and the token
+    # gather partitions cleanly over the (batch, seq)-sharded indices.
+    ("embed_vocab", "fsdp"),
 )
 
 with_logical = nn.with_logical_constraint
@@ -164,22 +172,28 @@ class Embeddings(nn.Module):
     def __call__(self, input_ids, token_type_ids, deterministic,
                  position_ids=None):
         cfg = self.cfg
+        # Embedding tables shard on their ROW (token-id) dim over fsdp
+        # only — an embed-dim ("embed"→fsdp) sharding here would propagate
+        # into the gather outputs as embed-sharded [B, L, E] activations
+        # that XLA cannot reshard to (batch, seq) without involuntary full
+        # rematerialization (see LOGICAL_AXIS_RULES).
         word = nn.Embed(
             cfg.vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), ("vocab", "embed")),
+                _dense_init(cfg), ("embed_vocab", None)),
             name="word_embeddings")(input_ids)
+        word = with_logical(word, ("batch", "seq", None))
         if position_ids is None:
             position_ids = jnp.arange(input_ids.shape[1])[None, :]
         pos = nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), (None, "embed")),
+                _dense_init(cfg), ("embed_vocab", None)),
             name="position_embeddings")(position_ids)
         typ = nn.Embed(
             cfg.type_vocab_size, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
-                _dense_init(cfg), (None, "embed")),
+                _dense_init(cfg), (None, None)),
             name="token_type_embeddings")(token_type_ids)
         x = word + pos + typ
         x = with_logical(x, ("batch", "seq", "act_embed"))
