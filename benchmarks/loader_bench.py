@@ -25,7 +25,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 
-def _build_dataset(tmp, mb):
+def _build_dataset(tmp, mb, which=None):
+    """``which``: build only the named dataset(s) ("static_binned" /
+    "dynamic_unbinned"); None builds both (the full bench)."""
     from bench import make_corpus
     from lddl_tpu.preprocess import (BertPretrainConfig, build_wordpiece_vocab,
                                      get_tokenizer, run_bert_preprocess)
@@ -48,6 +50,8 @@ def _build_dataset(tmp, mb):
     datasets = {}
     for name, masking, bin_size in (("static_binned", True, 32),
                                     ("dynamic_unbinned", False, None)):
+        if which is not None and name not in which:
+            continue
         pre = os.path.join(tmp, "pre_" + name)
         bal = os.path.join(tmp, "bal_" + name)
         run_bert_preprocess(
@@ -160,12 +164,38 @@ def main():
             results[name] = _run_mock_train(path, vocab, extra,
                                             args.batch_size)
             print(name, results[name], flush=True)
+            # Worker-scaling verdict (VERDICT r4 #8), recorded here; the
+            # hard assert lives in tests/test_loader.py::
+            # test_thread_workers_scale_on_multicore, which un-skips on
+            # the first >= 4-core host. On < 4 cores w4 == w1 is the
+            # expected (and honest) result.
+            scaling = None
+            w1 = results.get("static_binned_w1")
+            w4 = results.get("static_binned_w4")
+            if w1 and w4:
+                # Sustained rate (post-warmup), the headline metric —
+                # burst samples_per_s is buffer-fill noise on small runs.
+                key = ("sustained_samples_per_s"
+                       if "sustained_samples_per_s" in w4
+                       else "samples_per_s")
+                multicore = (os.cpu_count() or 1) >= 4
+                wins = w4[key] > w1[key]
+                scaling = {
+                    "metric": key,
+                    "thread_w4_over_w1": round(w4[key] / w1[key], 3),
+                    "host_can_show_scaling": multicore,
+                    "verdict": ("w4 > w1" if wins else "w4 <= w1 ({})".
+                                format("INVESTIGATE: multi-core host"
+                                       if multicore else
+                                       "expected on a < 4-core host")),
+                }
             payload = {
                 "unit": "samples/s (loader-only wall clock incl. decode, "
                         "shuffle buffer, collate, dynamic masking)",
                 "corpus_mb": args.mb,
                 "batch_size": args.batch_size,
                 "cpu_count": os.cpu_count(),
+                "worker_scaling": scaling,
                 "configs": results,
             }
             # Written incrementally so a late-config crash keeps the rest.

@@ -93,6 +93,7 @@ class Embeddings(nn.Module):
     def __call__(self, token_embed, input_ids, deterministic):
         cfg = self.cfg
         x = token_embed(input_ids)
+        x = with_logical(x, ("batch", "seq", None))
         pos = nn.Embed(
             cfg.max_position_embeddings, cfg.hidden_size, dtype=cfg.dtype,
             embedding_init=nn.with_logical_partitioning(
