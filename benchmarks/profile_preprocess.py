@@ -1,25 +1,66 @@
-"""Attribute preprocess time: cProfile the single-worker headline bench run.
+"""Attribute preprocess time: cProfile the single-worker headline config.
 
-Usage: python benchmarks/profile_preprocess.py [MB]
-Prints the top cumulative-time entries plus a phase breakdown
-(scatter / gather-read / bucket-process), to attribute regressions like
-the round-3 one (VERDICT.md round 3, item 1).
+Usage: python benchmarks/profile_preprocess.py [MB] [--out PATH]
+Prints the top cumulative/tottime entries and writes the sink breakdown
+JSON to ``--out`` (default: PROFILE_PREPROCESS.json at the repo root —
+the committed attribution artifact VERDICT r4 #4 asks for; point --out
+elsewhere when profiling scratch experiments so the committed artifact
+is not clobbered). The run is single-worker so the profile sees the
+worker's actual work; the headline bench adds a process pool around
+exactly this per-bucket pipeline.
+
+Sink buckets (module-level attribution, C++ engine time shows up under
+the ctypes call):
+  tokenize_native  — the one-pass C++ split+normalize+WordPiece engine
+  masking          — ops/masking numpy batch masking
+  arrow_write      — parquet/arrow column building + write (incl. lz4)
+  spool_io         — radix spool scatter/gather text IO
+  pairs/instances  — pair assembly from tokenized sentences
+  other_python     — everything else
 """
 
 import cProfile
 import io
+import json
 import os
 import pstats
 import shutil
 import sys
 import tempfile
+import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
 import bench  # noqa: E402  (repo-root bench.py: corpus + vocab helpers)
 
 
+_SINKS = (
+    ("tokenize_native", ("native/__init__", "ctypes")),
+    ("masking", ("ops/masking",)),
+    ("arrow_write", ("arrowcols", "binning", "pyarrow", "parquet")),
+    ("spool_io", ("_read_group", "_scatter", "_write_txt", "spool",
+                  "readers")),
+    ("pairs_instances", ("preprocess/bert", "pairs_from", "instances_from")),
+)
+
+
+def _sink_of(func):
+    filename, _, name = func
+    key = "{}:{}".format(filename.replace(os.sep, "/"), name)
+    for sink, needles in _SINKS:
+        if any(n in key for n in needles):
+            return sink
+    return "other_python"
+
+
 def main():
-    target_mb = float(sys.argv[1]) if len(sys.argv) > 1 else 24.0
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("mb", nargs="?", type=float, default=24.0)
+    ap.add_argument("--out",
+                    default=os.path.join(ROOT, "PROFILE_PREPROCESS.json"))
+    ns = ap.parse_args()
+    target_mb = ns.mb
     tmp = tempfile.mkdtemp(prefix="lddl_prof_")
     try:
         from lddl_tpu.preprocess import (
@@ -41,33 +82,69 @@ def main():
             sample, os.path.join(tmp, "vocab.txt"), vocab_size=30522)
         tokenizer = get_tokenizer(vocab_file=vocab)
 
+        def run(out_name, corpus_dir):
+            return run_bert_preprocess(
+                {"wikipedia": corpus_dir}, os.path.join(tmp, out_name),
+                tokenizer,
+                config=BertPretrainConfig(
+                    max_seq_length=128, duplicate_factor=1, masking=True,
+                    engine="numpy", tokenizer_engine="auto"),
+                num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=32,
+                num_workers=1)
+
         # Warmup (native build, tokenizer tables) outside the profile.
         warm = os.path.join(tmp, "warm")
         bench.make_corpus(warm, 1, seed=2)
-        run_bert_preprocess(
-            {"wikipedia": warm}, os.path.join(tmp, "out_warm"), tokenizer,
-            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
-                                      masking=True, engine="numpy",
-                                      tokenizer_engine="auto"),
-            num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=32,
-            num_workers=1)
+        run("out_warm", warm)
 
         prof = cProfile.Profile()
+        t0 = time.perf_counter()
         prof.enable()
-        run_bert_preprocess(
-            {"wikipedia": corpus}, os.path.join(tmp, "out_main"), tokenizer,
-            config=BertPretrainConfig(max_seq_length=128, duplicate_factor=1,
-                                      masking=True, engine="numpy",
-                                      tokenizer_engine="auto"),
-            num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=32,
-            num_workers=1)
+        run("out_main", corpus)
         prof.disable()
+        elapsed = time.perf_counter() - t0
 
         buf = io.StringIO()
         st = pstats.Stats(prof, stream=buf)
         st.sort_stats("cumulative").print_stats(40)
         st.sort_stats("tottime").print_stats(30)
         print(buf.getvalue())
+
+        # Aggregate tottime into named sinks + top functions, and write
+        # the committed artifact.
+        sinks = {}
+        rows = []
+        # NB: pstats.Stats(prof) consumes the profiler's raw entries;
+        # the (file, line, func) -> (cc, nc, tt, ct, callers) table
+        # lives on the Stats object afterwards.
+        for func, (cc, nc, tt, ct, callers) in st.stats.items():
+            sinks[_sink_of(func)] = sinks.get(_sink_of(func), 0.0) + tt
+            rows.append((tt, ct, "{}:{}:{}".format(
+                os.sep.join(func[0].split(os.sep)[-2:]), func[1], func[2])))
+        rows.sort(reverse=True)
+        total = sum(s for s in sinks.values()) or 1.0
+        payload = {
+            "config": "headline (native tokenizer engine, numpy masking, "
+                      "bin 32, L 128), single worker",
+            "corpus_mb": round(nbytes / 1024 / 1024, 2),
+            "elapsed_s": round(elapsed, 2),
+            "mb_per_s_single_worker": round(nbytes / 1024 / 1024 / elapsed,
+                                            3),
+            "host_calibration_s": bench.host_calibration(),
+            "sinks_tottime_s": {
+                k: {"s": round(v, 3), "share_pct": round(100 * v / total, 1)}
+                for k, v in sorted(sinks.items(), key=lambda kv: -kv[1])},
+            "top_functions_tottime": [
+                {"tottime_s": round(tt, 3), "cumtime_s": round(ct, 3),
+                 "where": where}
+                for tt, ct, where in rows[:12]],
+            "note": "cProfile adds interpreter overhead (~10-25%); use "
+                    "shares, not absolute seconds, and compare MB/s only "
+                    "against other single-worker profiled runs.",
+        }
+        with open(ns.out, "w") as f:
+            json.dump(payload, f, indent=1)
+        print("wrote", ns.out)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -151,8 +151,11 @@ def join_tokens(flat_ids, row_lens, blob, tok_starts, tok_lens,
 
 
 def _pack_docs(texts):
-    """Encode texts into one UTF-8 buffer + int64 offsets array."""
-    encoded = [t.encode("utf-8") for t in texts]
+    """Concatenate texts into one UTF-8 buffer + int64 offsets array.
+    Accepts bytes (the preprocess pipeline's zero-decode path — the C++
+    engine is the first and only UTF-8 decoder) or str."""
+    encoded = [t if isinstance(t, bytes) else t.encode("utf-8")
+               for t in texts]
     offsets = np.zeros(len(encoded) + 1, dtype=np.int64)
     np.cumsum([len(e) for e in encoded], out=offsets[1:])
     return b"".join(encoded), offsets

@@ -94,6 +94,11 @@ class BartBucketProcessor:
         lrng.shuffle(g, texts)
         rows = []
         for text in texts:
+            # The runner hands raw document BYTES (zero-decode spool
+            # path); BART chunking is str-based, so decode per document
+            # here — after the shuffle, which is order-only.
+            if isinstance(text, bytes):
+                text = text.decode("utf-8", errors="replace")
             rows.extend(chunks_from_text(
                 text, self.config, g,
                 splitter_params=self.splitter_params))

@@ -287,6 +287,11 @@ def documents_from_texts(texts, tokenizer, engine="auto",
             return _documents_from_texts_native(texts, nat)
         if engine == "native":
             raise RuntimeError("native tokenizer engine unavailable")
+    # Non-native path: the pipeline hands document text as raw bytes
+    # (readers.read_block_lines); decode here, exactly as the old
+    # str-everywhere pipeline did at read time.
+    texts = [t.decode("utf-8", errors="replace") if isinstance(t, bytes)
+             else t for t in texts]
     if splitter_params is not None:
         from .sentences import split_sentences_learned
         doc_sentences = [split_sentences_learned(t, splitter_params)

@@ -6,9 +6,14 @@ and deterministic instead (SURVEY.md §7.4): the input corpus is planned into
 an explicit list of byte-range Blocks once, identically on every host, and
 hosts/workers pick blocks by striding — no task scheduler process needed.
 
-Input contract (downloader output): text files where each line is one
-document and the first whitespace-separated token is the document id
-(ref: lddl/dask/readers.py:131-136).
+Input contract (downloader output): UTF-8 text files where each line is
+one document and the first ASCII-whitespace-separated token is the
+document id (ref: lddl/dask/readers.py:131-136). The id/text split and
+the empty-document filter are ASCII-whitespace-based (bytes semantics,
+round 5): a document id separated from its text by a Unicode-only space
+(e.g. NBSP) is treated as having empty text and dropped — the bundled
+downloaders always emit ASCII separators; normalize external corpora to
+this contract.
 """
 
 import dataclasses
@@ -81,10 +86,18 @@ def plan_blocks(input_files, target_num_blocks):
 
 
 def read_block_lines(block):
-    """Yield the lines that start inside ``block`` (whole lines, no \\n).
+    """Yield the RAW BYTES of the lines that start inside ``block`` (whole
+    lines, no trailing \\n).
 
     Boundary rule: a line belongs to the block containing its first byte.
     A block whose start is mid-line skips forward to the next line start.
+
+    Bytes, not str, on purpose: document text flows corpus -> spool ->
+    gather -> C++ engine without ever paying a UTF-8 decode + re-encode
+    round-trip over the whole corpus (the engine decodes once, in C; the
+    HF/text fallback paths decode lazily at their entry points with
+    errors="replace", the old behavior). Invalid UTF-8 is neutral either
+    way: both the native normalizer and HF's clean_text drop U+FFFD.
     """
     with open(block.path, "rb") as f:
         if block.start == 0:
@@ -100,23 +113,25 @@ def read_block_lines(block):
             line = f.readline()
             if not line:
                 break
-            yield line.decode("utf-8", errors="replace").rstrip("\n")
+            yield line[:-1] if line.endswith(b"\n") else line
 
 
 def split_id_text(raw_line):
-    """'<doc id> <text...>' -> (doc_id, text). (ref: readers.py:131-136)"""
+    """'<doc id> <text...>' -> (doc_id, text); bytes in, bytes out (or str
+    in, str out — the split-on-whitespace contract is ASCII whitespace,
+    per the downloader output format). (ref: readers.py:131-136)"""
     parts = raw_line.split(None, 1)
     if len(parts) == 0:
-        return None, ""
+        return None, raw_line[:0]
     if len(parts) == 1:
-        return parts[0], ""
+        return parts[0], raw_line[:0]
     return parts[0], parts[1]
 
 
 def read_documents(block, sample_ratio=1.0, base_seed=12345):
-    """Yield (doc_id, text) for non-empty documents of a block, keeping each
-    with probability ``sample_ratio`` (seeded per block, ref:
-    readers.py:60-71 random_sample)."""
+    """Yield (doc_id, text) BYTES pairs for non-empty documents of a
+    block, keeping each with probability ``sample_ratio`` (seeded per
+    block, ref: readers.py:60-71 random_sample)."""
     g = lrng.sample_rng(base_seed, block.block_id) if sample_ratio < 1.0 else None
     for line in read_block_lines(block):
         if not line.strip():

@@ -308,15 +308,17 @@ def _spool_one_block(block, out_dir, seed, sample_ratio, nbuckets, ngroups,
     for g, by_bucket in sorted(by_group.items()):
         group_dir = os.path.join(spool_root, "group-{}".format(g))
         os.makedirs(group_dir, exist_ok=True)
+        # Raw bytes end to end (see readers.read_block_lines): document
+        # bytes are appended exactly as read, never decoded.
         parts = []
         for b, texts in sorted(by_bucket.items()):
-            parts.append("#B {} {}\n".format(block.block_id, b))
+            parts.append("#B {} {}\n".format(block.block_id, b).encode())
             for text in texts:
-                parts.append(" ")
+                parts.append(b" ")
                 parts.append(text)
-                parts.append("\n")
+                parts.append(b"\n")
         with open(os.path.join(group_dir, "w{}.txt".format(writer_tag)),
-                  "a", encoding="utf-8") as f:
+                  "ab") as f:
             f.writelines(parts)
 
 
@@ -334,19 +336,24 @@ def _read_group_texts(out_dir, group, nbuckets, ngroups):
     if not os.path.isdir(group_dir):
         return {b: [] for b in by_bucket}
     for name in sorted(os.listdir(group_dir)):
-        with open(os.path.join(group_dir, name), encoding="utf-8") as f:
-            current = None
-            for line in f:
-                if line.startswith("#B "):
-                    hdr = line.split()
-                    blocks = (by_bucket.get(int(hdr[2]))
-                              if len(hdr) == 3 else None)
-                    current = (None if blocks is None
-                               else blocks.setdefault(hdr[1], []))
-                elif current is not None:
-                    text = line[1:-1] if line.endswith("\n") else line[1:]
-                    if text:
-                        current.append(text)
+        # Bulk binary read + one C-level split: no per-line decode, no
+        # per-line iterator overhead. Document bytes stay bytes all the
+        # way into the C++ engine. Block keys stay BYTES digit strings —
+        # lex order over ASCII digits matches the old str sort exactly.
+        with open(os.path.join(group_dir, name), "rb") as f:
+            data = f.read()
+        current = None
+        for line in data.split(b"\n"):
+            if line.startswith(b"#B "):
+                hdr = line.split()
+                blocks = (by_bucket.get(int(hdr[2].decode()))
+                          if len(hdr) == 3 else None)
+                current = (None if blocks is None
+                           else blocks.setdefault(hdr[1], []))
+            elif current is not None:
+                text = line[1:]
+                if text:
+                    current.append(text)
     return {
         b: [t for _, ts in sorted(blocks.items()) for t in ts]
         for b, blocks in by_bucket.items()

@@ -17,7 +17,10 @@ def _read_all_docs(outdir):
     files = discover_source_files({"x": outdir})
     docs = []
     for b in plan_blocks(files, len(files)):
-        docs.extend(read_documents(b))
+        # read_documents yields raw bytes (zero-decode pipeline); these
+        # assertions are about downloader CONTENT, so decode for clarity.
+        docs.extend((d.decode("utf-8"), t.decode("utf-8"))
+                    for d, t in read_documents(b))
     return docs
 
 

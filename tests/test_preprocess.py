@@ -107,8 +107,8 @@ def test_plan_blocks_and_read(tiny_corpus):
         all_lines.extend(read_block_lines(b))
     expected = []
     for p in files:
-        with open(p) as f:
-            expected.extend(l.rstrip("\n") for l in f)
+        with open(p, "rb") as f:
+            expected.extend(l.rstrip(b"\n") for l in f)
     assert sorted(all_lines) == sorted(expected)
 
 
