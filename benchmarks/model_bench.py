@@ -218,9 +218,11 @@ def main():
                     num_heads=4, intermediate_size=128)
     else:
         # bert_large @ L=512 is the reference's own headline pretraining
-        # config (phase2); base @ 1024 pins the auto-selection crossover
-        # (attention.resolve_auto_impl flips to flash at L >= 1024); base
-        # @ 2048 exercises the long-context story.
+        # config (phase2), served by the round-5 single-block kernels
+        # (auto picks flash for 256 <= l_pad <= 512 and l_pad >= 1024,
+        # dense at the shortest bins and in the 512 < l_pad < 1024 band —
+        # attention.resolve_auto_impl); base @ 1024 pins the online
+        # kernels' side; base @ 2048 exercises the long-context story.
         configs = [("bert_base", 32, 512, 96), ("bert_base", 8, 1024, 48),
                    ("bert_base", 4, 2048, 48), ("bert_large", 12, 512, 128)]
         base = {}

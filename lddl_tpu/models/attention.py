@@ -21,20 +21,33 @@ with_logical = nn.with_logical_constraint
 
 
 def resolve_auto_impl(seq_len, blockwise_ok, attention_dropout,
-                      deterministic=False):
+                      deterministic=False, *, head_dim):
     """attention_impl="auto" -> "flash"|"dense" (measured selection,
-    MODEL_BENCH.json): the pallas flash kernel wins where attention
-    dominates (L >= ~1024 — 33.9% vs 27.0% MFU at L=2048, round 4) but
-    loses ~2 MFU points at the reference's L=512 headline config to its
-    per-layer layout transposes (XLA's dense attention fuses into the
-    surrounding ops; the kernel's [B*H, L, D] relayout does not). Flash
-    is picked only when it computes the SAME math as dense (it skips
+    MODEL_BENCH.json). The round-5 single-block kernels
+    (ops/flash_attention.py, fat (b, h)-row cells + one fused backward)
+    made the pallas path win or tie everywhere from L = 256 up — incl.
+    the reference's L=512 headline config that rounds 3-4 conceded to
+    XLA's fused dense attention (bert_base 45.2 vs 42.2 wall MFU,
+    bert_large parity within noise, round-5 chip probes), and the online
+    kernels keep their long-L wins (L=1024: 36.3 vs 34.0; L=2048: 35.6
+    vs 28.0, round 4). Dense stays ahead only at L <= 128 (52.1 vs 42.1
+    at the shortest bin) where per-kernel-launch overhead dominates.
+    In the band BETWEEN the regimes (512 < L_pad < 1024) the single-block
+    kernels disengage and the online kernels measurably lose (L=768:
+    33.9 vs 38.1, round-5 probe), so dense holds it. Flash is picked
+    only when it computes the SAME math as dense (it skips
     attention-prob dropout, so dropout > 0 pins dense — unless the call
     is deterministic, where dropout is a no-op and flash is identical):
     auto never changes the trained model, only the speed."""
+    from ..ops.flash_attention import pad_seq_len, single_block_serves
+
     effective_dropout = 0.0 if deterministic else attention_dropout
-    return ("flash" if blockwise_ok and seq_len >= 1024
-            and effective_dropout == 0.0 else "dense")
+    # single_block_serves is the dispatcher's own predicate (incl. its
+    # head-dim gate), so the selector can never promise the single-block
+    # regime where flash_attention would fall back to the online kernels.
+    return ("flash" if blockwise_ok and effective_dropout == 0.0
+            and (single_block_serves(seq_len, head_dim)
+                 or pad_seq_len(seq_len) >= 1024) else "dense")
 
 
 class MultiHeadAttention(nn.Module):
@@ -82,7 +95,8 @@ class MultiHeadAttention(nn.Module):
         impl = self.attention_impl
         if impl == "auto":
             impl = resolve_auto_impl(q_input.shape[1], blockwise_ok,
-                                     self.dropout, deterministic)
+                                     self.dropout, deterministic,
+                                     head_dim=head_dim)
         use_ring = False
         if impl == "ring" and blockwise_ok:
             from jax.sharding import get_abstract_mesh

@@ -86,8 +86,9 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     dtype: Any = jnp.bfloat16  # activations; params stay fp32
-    # "auto" (default): dense at short L, the pallas flash kernel where
-    # it measurably wins (L >= 1024) AND computes identical math
+    # "auto" (default): dense at the shortest bins, the pallas flash
+    # kernel where it measurably wins or ties (L >= 256 since the
+    # round-5 single-block kernels) AND computes identical math
     # (attention_dropout == 0 — flash skips prob dropout); the choice is
     # per traced sequence length, so no config silently runs the slower
     # impl (MODEL_BENCH.json). "dense": all-gather from sp into

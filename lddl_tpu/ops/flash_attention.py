@@ -1,22 +1,31 @@
-"""Pallas fused attention (flash-style online softmax) for TPU.
+"""Pallas fused attention for TPU — two regimes behind one entry point.
 
-The hot op of the model stack as hand-written TPU kernels: per
-(batch, head), Q blocks stream through VMEM while the kernel walks K/V
-in blocks under a running-max/denominator softmax — the L x L score
-matrix never exists in HBM, scores accumulate in fp32 on the MXU
-(``preferred_element_type``), and the output is written once per Q
-block. The backward pass is pallas too (the standard flash recipe): the
-forward saves the per-row log-sum-exp, backward recomputes P blockwise
-from (Q, K, LSE) and accumulates dQ in a Q-block kernel and dK/dV in a
-KV-block kernel — no L x L materialization anywhere in training either.
+Short sequences (L_pad <= 512, the reference's headline pretraining
+regime — /root/reference/lddl/dask/bert/pretrain.py:627-637): the
+"single-block" kernels. The whole L x L score matrix for one (batch,
+head) row fits VMEM, so the forward computes an ordinary (not online)
+softmax in one pass, and the backward is ONE fused kernel that
+recomputes P once and emits dQ, dK, dV together (5 matmuls vs the
+two-kernel online recipe's 7). Cells are fat: ``nbh`` (batch, head)
+rows per grid cell (same batch row, so the mask/allowed matrix is built
+once per cell), which amortizes per-cell overheads that dominate at
+short L — this is what makes the pallas kernel BEAT XLA's fused dense
+attention at L = 512 (round-5 micro-bench + MODEL_BENCH.json), where
+rounds 3-4 lost to it.
 
-Scope (documented, tested):
-- K/V (and in backward Q/dO) are VMEM-resident per (batch, head) — the
-  right regime for L up to a few thousand (VMEM is ~16 MiB/core).
-- numerics match ops.ring_attention.dense_attention_reference (same
-  finite -1e9 padding bias), pinned by interpret-mode tests on CPU for
-  forward AND gradients; the kernels compile and run on a real TPU chip
-  via the same entry point (FLASH_ATTENTION_BENCH.json).
+Long sequences: the flash-style online-softmax kernels. Per (batch,
+head), Q blocks stream through VMEM while the kernel walks K/V blocks
+under a running-max/denominator softmax — the L x L score matrix never
+exists anywhere. Backward recomputes P blockwise from (Q, K, LSE): dQ
+in a Q-block kernel, dK/dV in a KV-block kernel.
+
+Both regimes: matmul operands stay in the stored dtype (bf16 in
+training) with fp32 accumulation on the MXU
+(``preferred_element_type``); the forward saves per-row log-sum-exp for
+the backward; numerics match ops.ring_attention.dense_attention_reference
+pinned by interpret-mode tests on CPU for forward AND gradients, and
+the same entry point compiles and runs on a real TPU chip
+(FLASH_ATTENTION_BENCH.json, MODEL_BENCH.json).
 
 ``interpret=None`` auto-selects: real pallas lowering on TPU, interpret
 mode elsewhere (CPU CI).
@@ -179,7 +188,7 @@ def _prep(q, k, v, kv_mask, q_mask):
     Returns (qb, kb, vb, maskb[B,1,Lp], qmaskb[B,1,Lp], shapes)."""
     import jax.numpy as jnp
     b, l, h, d = q.shape
-    l_pad = -(-l // 128) * 128
+    l_pad = pad_seq_len(l)
     if q_mask is None:
         # Plain padding mask: the kernel's test is (msk > 0) & (msk == qm),
         # so a truthy value other than 1 (int mask from a sum, bool*2, ...)
@@ -215,9 +224,24 @@ def flash_attention_fwd(q, k, v, kv_mask, interpret=None, q_mask=None):
         interpret = jax.default_backend() != "tpu"
     qb, kb, vb, maskb, qmaskb, (b, l, h, d, l_pad) = _prep(
         q, k, v, kv_mask, q_mask)
+    scale = 1.0 / (d ** 0.5)
+    if _use_onekv(l_pad, d):
+        nbh = _nbh_for(h)
+        spec, spec_mask, spec_row = _onekv_specs(nbh, l_pad, d, h)
+        out, lse = pl.pallas_call(
+            functools.partial(_onekv_fwd_kernel, scale=scale, nbh=nbh),
+            grid=(b * h // nbh,),
+            in_specs=[spec, spec, spec, spec_mask, spec_mask],
+            out_specs=[spec, spec_row],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, l_pad, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, 1, l_pad), jnp.float32),
+            ],
+            interpret=interpret,
+        )(qb, kb, vb, maskb, qmaskb)
+        return _from_bh(out, b, l, h, d), lse
     tq, tk = _block_sizes(l_pad)
     assert l_pad % tq == 0 and l_pad % tk == 0, (l_pad, tq, tk)
-    scale = 1.0 / (d ** 0.5)
     kernel = functools.partial(_fwd_kernel, scale=scale,
                                n_kv=l_pad // tk, tk=tk)
     out, lse = pl.pallas_call(
@@ -246,8 +270,10 @@ def flash_attention_fwd(q, k, v, kv_mask, interpret=None, q_mask=None):
 
 def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
                         q_mask=None):
-    """Pallas backward: recomputes P blockwise from (Q, K, LSE); dQ from a
-    Q-block kernel, dK/dV from a KV-block kernel."""
+    """Pallas backward. Single-block regime: ONE fused kernel recomputes P
+    once and emits dQ, dK, dV together. Online regime: P recomputed
+    blockwise from (Q, K, LSE); dQ from a Q-block kernel, dK/dV from a
+    KV-block kernel."""
     import jax
     from jax.experimental import pallas as pl
     import jax.numpy as jnp
@@ -262,6 +288,22 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
     # delta_i = sum_d dO_id * O_id, per query row.
     delta = (dob.astype(jnp.float32) * ob.astype(jnp.float32)).sum(
         axis=-1).reshape(b * h, 1, l_pad)
+
+    if _use_onekv(l_pad, d):
+        nbh = _nbh_for(h)
+        spec, spec_mask, spec_row = _onekv_specs(nbh, l_pad, d, h)
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_onekv_bwd_kernel, scale=scale, nbh=nbh),
+            grid=(b * h // nbh,),
+            in_specs=[spec, spec, spec, spec_mask, spec_mask, spec,
+                      spec_row, spec_row],
+            out_specs=[spec, spec, spec],
+            out_shape=[jax.ShapeDtypeStruct((b * h, l_pad, d), t.dtype)
+                       for t in (q, k, v)],
+            interpret=interpret,
+        )(qb, kb, vb, maskb, qmaskb, dob, lse, delta)
+        return (_from_bh(dq, b, l, h, d), _from_bh(dk, b, l, h, d),
+                _from_bh(dv, b, l, h, d))
 
     tq, tk = _block_sizes(l_pad)
     assert l_pad % tq == 0 and l_pad % tk == 0, (l_pad, tq, tk)
@@ -314,6 +356,123 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
     )(qb, kb, vb, maskb, qmaskb, dob, lse, delta)
     return (_from_bh(dq, b, l, h, d), _from_bh(dk, b, l, h, d),
             _from_bh(dv, b, l, h, d))
+
+
+# ---------------------------------------------------------------------------
+# Single-block ("onekv") kernels: the L_pad <= 512 regime.
+#
+# Per grid cell, ``nbh`` consecutive (batch, head) rows — all of the SAME
+# batch row (dispatch guarantees nbh divides num_heads) — are processed with
+# whole-row [L, L] score matrices in VMEM. The -1e9 additive mask (the same
+# finite-bias convention as the online kernels and the dense reference —
+# never multiply-after-exp, whose raw-score row max lets one disallowed
+# outlier key underflow every allowed probability) is built ONCE per cell
+# and reused by all nbh rows; the 1/l normalization is folded into the
+# [L, D] output instead of the [L, L] probabilities. The backward is one
+# fused kernel: P is recomputed once and dQ, dK, dV all emitted from it.
+# ---------------------------------------------------------------------------
+
+
+ONEKV_MAX_L_PAD = 512
+
+
+def pad_seq_len(l):
+    """_prep's padding rule: L pads to the next multiple of 128."""
+    return -(-l // 128) * 128
+
+
+def _use_onekv(l_pad, d):
+    """Single-block dispatch: the [L, L] per-row score matrix and the fused
+    backward's temporaries must fit VMEM alongside nbh rows of blocks."""
+    return l_pad <= ONEKV_MAX_L_PAD and d <= 128
+
+
+def single_block_serves(seq_len, head_dim):
+    """True when flash_attention will dispatch the single-block kernels for
+    this shape AND they are in their measured winning range (l_pad >= 256 —
+    dense keeps the shortest bins, MODEL_BENCH.json). The ONE predicate
+    models/attention.resolve_auto_impl consults, so the selector can never
+    drift from the dispatcher."""
+    l_pad = pad_seq_len(seq_len)
+    return l_pad >= 256 and _use_onekv(l_pad, head_dim)
+
+
+def _nbh_for(h):
+    """Rows per cell: largest of 4/2/1 dividing num_heads, so every cell's
+    rows share one batch row (mask built once per cell)."""
+    return 4 if h % 4 == 0 else (2 if h % 2 == 0 else 1)
+
+
+def _dot0(a, b):
+    """Contract over axis 0 of both: a [M, N], b [M, D] -> [N, D]."""
+    import jax
+    import jax.numpy as jnp
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _cell_bias(mask_ref, qmask_ref):
+    """[L, L] additive mask for one cell (rows share the batch row):
+    0 where the key is valid AND in the query's segment, -1e9 elsewhere —
+    the same finite-bias convention as the online kernels (fp32 min would
+    overflow in bf16; exp(-1e9 - m) underflows to an exact 0 probability,
+    and an all-masked row softmaxes to the uniform average, matching the
+    dense reference)."""
+    import jax.numpy as jnp
+    msk = mask_ref[0, 0]
+    qm = qmask_ref[0, 0]
+    allowed = (msk[None, :] > 0) & (msk[None, :] == qm[:, None])
+    return jnp.where(allowed, 0.0, -1e9)
+
+
+def _onekv_fwd_kernel(q_ref, k_ref, v_ref, mask_ref, qmask_ref, o_ref,
+                      lse_ref, *, scale, nbh):
+    import jax.numpy as jnp
+
+    bias = _cell_bias(mask_ref, qmask_ref)
+    for i in range(nbh):
+        q = q_ref[i]                             # [L, D], stored dtype
+        k = k_ref[i]
+        v = v_ref[i]
+        s = _dot(q, k, transpose_b=True) * scale + bias  # fp32 [L, L]
+        m = s.max(axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.maximum(p.sum(axis=1, keepdims=True), 1e-30)
+        o = _dot(p.astype(v.dtype), v)            # [L, D] fp32, unnormalized
+        o_ref[i] = (o * (1.0 / l)).astype(o_ref.dtype)
+        lse_ref[i, 0] = m[:, 0] + jnp.log(l[:, 0])
+
+
+def _onekv_bwd_kernel(q_ref, k_ref, v_ref, mask_ref, qmask_ref, do_ref,
+                      lse_ref, delta_ref, dq_ref, dk_ref, dv_ref, *, scale,
+                      nbh):
+    import jax.numpy as jnp
+
+    bias = _cell_bias(mask_ref, qmask_ref)
+    for i in range(nbh):
+        q = q_ref[i]
+        k = k_ref[i]
+        v = v_ref[i]
+        do = do_ref[i]
+        lse = lse_ref[i, 0][:, None]             # [L, 1]
+        delta = delta_ref[i, 0][:, None]
+        s = _dot(q, k, transpose_b=True) * scale + bias
+        p = jnp.exp(s - lse)                     # fp32 [Lq, Lk]
+        dp = _dot(do, v, transpose_b=True)       # fp32 [Lq, Lk]
+        dv_ref[i] = _dot0(p.astype(do.dtype), do).astype(dv_ref.dtype)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dq_ref[i] = _dot(ds, k).astype(dq_ref.dtype)
+        dk_ref[i] = _dot0(ds, q).astype(dk_ref.dtype)
+
+
+def _onekv_specs(nbh, l_pad, d, h):
+    """(row spec, mask spec) for grid (b*h // nbh,): cell g covers bh rows
+    [g*nbh, (g+1)*nbh), all in batch row (g*nbh)//h."""
+    from jax.experimental import pallas as pl
+    spec = pl.BlockSpec((nbh, l_pad, d), lambda g: (g, 0, 0))
+    spec_mask = pl.BlockSpec((1, 1, l_pad), lambda g: (g * nbh // h, 0, 0))
+    spec_row = pl.BlockSpec((nbh, 1, l_pad), lambda g: (g, 0, 0))
+    return spec, spec_mask, spec_row
 
 
 _FLASH_VJP = None

@@ -64,6 +64,107 @@ def test_jit_composes():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_onekv_dispatch_boundary():
+    """L_pad <= 512 runs the single-block kernels, above runs online."""
+    from lddl_tpu.ops.flash_attention import _use_onekv, _nbh_for
+
+    assert _use_onekv(512, 64)       # the reference's headline config
+    assert _use_onekv(128, 64)
+    assert not _use_onekv(640, 64)   # online regime
+    assert not _use_onekv(1024, 64)
+    assert _nbh_for(16) == 4 and _nbh_for(12) == 4   # bert head counts
+    assert _nbh_for(6) == 2 and _nbh_for(7) == 1
+
+
+def test_online_path_matches_dense_above_512():
+    """L=600 (l_pad=640 > ONEKV_MAX_L_PAD): the online-softmax kernels,
+    forward AND gradients vs the dense reference."""
+    q, k, v, _ = _inputs(l=600, seed=5)
+    mask = np.ones((2, 600), np.int32)
+    mask[0, 550:] = 0
+    mask = jnp.asarray(mask)
+
+    out = flash_attention(q, k, v, mask)
+    ref = dense_attention_reference(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_f(q, k, v):
+        return (flash_attention(q, k, v, mask) ** 2).sum()
+
+    def loss_d(q, k, v):
+        return (dense_attention_reference(q, k, v, mask) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("h", [4, 6, 3])
+def test_onekv_segments_match_dense_everywhere(h):
+    """Packed rows through the single-block kernels: block-diagonal
+    attention matches dense at EVERY row, including degenerate
+    (segment-id 0 = padding) rows, which softmax the all(-1e9) row to
+    the uniform value average under the shared bias convention.
+    h=4 runs nbh=4 cells, h=6 nbh=2 cells (three cells per batch row, so
+    the mask block index g*nbh//h diverges from the row block index),
+    h=3 the nbh=1 cells."""
+    g = np.random.default_rng(7)
+    b, l, d = 2, 256, 64
+    q = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.float32)
+    k = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.float32)
+    v = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.float32)
+    segs_np = np.zeros((b, l), np.int32)
+    segs_np[0, :100] = 1
+    segs_np[0, 100:200] = 2
+    segs_np[1, :250] = 1
+    segs = jnp.asarray(segs_np)
+
+    def dense_packed(q, k, v, segs):
+        scale = 1.0 / np.sqrt(q.shape[-1])
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        allowed = ((segs[:, None, :, None] == segs[:, None, None, :])
+                   & (segs[:, None, None, :] > 0))
+        probs = jax.nn.softmax(
+            scores + jnp.where(allowed, 0.0, -1e9), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    out = np.asarray(flash_attention(q, k, v, segments=segs))
+    ref = np.asarray(dense_packed(q, k, v, segs))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_masked_outlier_key_cannot_underflow_live_rows():
+    """Round-5 review regression: a DISALLOWED key whose raw score
+    dwarfs every allowed score (gap >> 88, the fp32 exp range) must not
+    drag the softmax row max up and underflow the allowed probabilities.
+    The -1e9 additive bias keeps the max on the allowed side; a
+    multiply-after-exp scheme (max over raw scores) returns 0 here."""
+    g = np.random.default_rng(11)
+    b, l, h, d = 1, 128, 4, 64
+    q = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.float32)
+    k_np = g.standard_normal((b, l, h, d))
+    k_np[0, 70] = 100.0 * np.asarray(q[0, 0])   # raw score ~ 800 vs ~ O(1)
+    k = jnp.asarray(k_np, jnp.float32)
+    v = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.float32)
+    segs = np.ones((b, l), np.int32)
+    segs[0, 70] = 2                              # the outlier is DISALLOWED
+    segs[0, 100:] = 0                            # for q rows in segment 1
+    segs = jnp.asarray(segs)
+
+    out = flash_attention(q, k, v, segments=segs)
+    assert float(jnp.abs(out[0, 0]).max()) > 1e-3   # row did not collapse
+
+    def loss_f(q, k, v):
+        return (flash_attention(q, k, v, segments=segs) ** 2).sum()
+
+    dq = jax.grad(loss_f)(q, k, v)
+    assert np.isfinite(np.asarray(dq)).all()
+    assert float(jnp.abs(dq[0, 0]).max()) > 1e-6
+
+
 def test_bert_flash_matches_dense_logits():
     """attention_impl='flash' in the full model (interpret mode off-TPU)
     matches dense logits with shared params."""

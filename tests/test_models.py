@@ -170,21 +170,31 @@ def test_multi_step_matches_single_steps(tiny_cfg):
 
 def test_attention_auto_selection(tiny_cfg):
     """attention_impl="auto" (the default) resolves by the measured rule —
-    flash only at L >= 1024 with attention_dropout == 0 and a blockwise-
-    compatible call — and at short L produces bit-identical outputs to
-    explicit dense (it IS dense there)."""
+    flash at L >= 256 (round-5 single-block kernels win/tie there, incl.
+    the reference's L=512 headline) with attention_dropout == 0 and a
+    blockwise-compatible call — and at the shortest bins produces
+    bit-identical outputs to explicit dense (it IS dense there)."""
     from lddl_tpu.models.attention import resolve_auto_impl
     from lddl_tpu.models.bert import BertForPreTraining
 
-    assert resolve_auto_impl(512, True, 0.0) == "dense"
-    assert resolve_auto_impl(1024, True, 0.0) == "flash"
-    assert resolve_auto_impl(2048, True, 0.0) == "flash"
-    assert resolve_auto_impl(2048, True, 0.1) == "dense"  # prob dropout
-    assert resolve_auto_impl(2048, False, 0.0) == "dense"  # causal/cross
+    assert resolve_auto_impl(128, True, 0.0, head_dim=64) == "dense"
+    assert resolve_auto_impl(256, True, 0.0, head_dim=64) == "flash"
+    assert resolve_auto_impl(512, True, 0.0, head_dim=64) == "flash"
+    # between the regimes the single-block kernels disengage and the
+    # online kernels lose to dense (L=768 probe, round 5)
+    assert resolve_auto_impl(768, True, 0.0, head_dim=64) == "dense"
+    assert resolve_auto_impl(1024, True, 0.0, head_dim=64) == "flash"
+    # the long branch reasons in l_pad: 960 pads to 1024 (online win)
+    assert resolve_auto_impl(960, True, 0.0, head_dim=64) == "flash"
+    # selector mirrors the dispatcher's head-dim gate: d > 128 would
+    # fall back to the (losing-at-512) online kernels, so stay dense
+    assert resolve_auto_impl(512, True, 0.0, head_dim=256) == "dense"
+    assert resolve_auto_impl(2048, True, 0.1, head_dim=64) == "dense"  # prob dropout
+    assert resolve_auto_impl(2048, False, 0.0, head_dim=64) == "dense"  # causal/cross
     # deterministic (eval): dropout is a no-op, so flash is identical math
     # and auto may pick it even with attention_dropout > 0 (ADVICE r4).
-    assert resolve_auto_impl(2048, True, 0.1, deterministic=True) == "flash"
-    assert resolve_auto_impl(512, True, 0.1, deterministic=True) == "dense"
+    assert resolve_auto_impl(2048, True, 0.1, deterministic=True, head_dim=64) == "flash"
+    assert resolve_auto_impl(128, True, 0.1, deterministic=True, head_dim=64) == "dense"
     assert BertConfig.tiny().attention_impl == "auto"
 
     batch = _fake_batch(tiny_cfg, B=4, L=64, seed=9)
