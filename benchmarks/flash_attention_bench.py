@@ -1,9 +1,14 @@
 """Pallas fused attention on the resolved JAX backend: correctness vs the
-XLA dense reference + wall-time envelope per shape.
+XLA dense reference + scan-amortized KERNEL speed per shape.
 
-Writes FLASH_ATTENTION_BENCH.json at the repo root. On the tunneled
-single-chip host the wall times ride an ~100ms remote-dispatch floor, so
-the meaningful recorded value there is max_abs_err on real hardware.
+Writes FLASH_ATTENTION_BENCH.json at the repo root. Each timed dispatch
+runs N_SCAN forward+backward attention iterations inside one lax.scan
+(grads fed back into the carry so nothing is dead code), which amortizes
+the tunneled chip's ~100 ms remote-dispatch floor to noise — the same
+methodology as MODEL_BENCH's multi-step train dispatches, but isolating
+the attention op. This is the direct kernel-level speed record the
+round-4 review asked for (previously only numerics were meaningful here);
+correctness columns are unchanged.
 
 Usage: python benchmarks/flash_attention_bench.py
 """
@@ -18,6 +23,46 @@ sys.path.insert(0, ROOT)
 
 import numpy as np
 
+N_SCAN = 50
+REPS = 4
+
+
+def _fb_loop(attn, n_iters):
+    """Scan of fwd+bwd iterations; grads fold into the carry."""
+    import jax
+    import jax.numpy as jnp
+    import jax.lax as lax
+
+    def step(c, _):
+        q, k, v = c
+
+        def loss(q, k, v):
+            return (attn(q, k, v) ** 2).sum().astype(jnp.float32)
+
+        l, (dq, dk, dv) = jax.value_and_grad(loss, (0, 1, 2))(q, k, v)
+        return (q + dq.astype(q.dtype) * 1e-6,
+                k + dk.astype(k.dtype) * 1e-6,
+                v + dv.astype(v.dtype) * 1e-6), l
+
+    def loop(q, k, v):
+        _, ls = lax.scan(step, (q, k, v), None, length=n_iters)
+        return ls[-1]
+
+    return loop
+
+
+def _time_loop(fn, args, reps, n_iters):
+    import jax
+    f = jax.jit(fn)
+    r = f(*args)
+    r.block_until_ready()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        f(*args).block_until_ready()
+        ts.append(time.perf_counter() - t0)
+    return min(ts) / n_iters, sorted(ts)[len(ts) // 2] / n_iters
+
 
 def main():
     import jax
@@ -25,16 +70,33 @@ def main():
     from lddl_tpu.ops.flash_attention import flash_attention
     from lddl_tpu.ops.ring_attention import dense_attention_reference
 
+    def dense_bf16(q, k, v, mask):
+        """XLA fused dense attention exactly as the model's dense path
+        computes it: bf16 operands AND bf16 softmax statistics
+        (jax.nn.softmax on the bf16 score tensor), matching
+        models/attention.py's dense branch."""
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9)
+        p = jax.nn.softmax(s + bias.astype(s.dtype), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
     g = np.random.default_rng(0)
     results = []
-    for (b, l, h, d) in [(8, 128, 12, 64), (4, 512, 12, 64),
-                         (1, 2048, 12, 64)]:
+    # bert_base short bin, the two headline L=512 shapes, long context
+    # (B=4 matches MODEL_BENCH's L=2048 row — B=1 leaves only 12 grid
+    # rows and under-utilizes the kernel's (b, h) grid).
+    for (tag, b, l, h, d) in [("base_L128", 8, 128, 12, 64),
+                              ("base_L512", 32, 512, 12, 64),
+                              ("large_L512", 12, 512, 16, 64),
+                              ("base_L2048", 4, 2048, 12, 64)]:
         q = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.bfloat16)
         k = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.bfloat16)
         v = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.bfloat16)
         mask = np.ones((b, l), np.int32)
         mask[0, l - l // 8:] = 0
         mask = jnp.asarray(mask)
+
         fa = jax.jit(lambda q, k, v, m: flash_attention(q, k, v, m))
         dn = jax.jit(dense_attention_reference)
         err = float(np.abs(np.asarray(fa(q, k, v, mask), np.float32)
@@ -52,29 +114,40 @@ def main():
         gerr = float(max(np.abs(np.asarray(a, np.float32)
                                 - np.asarray(b_, np.float32)).max()
                          for a, b_ in zip(gf, gd)))
-        t0 = time.perf_counter()
-        for _ in range(5):
-            fa(q, k, v, mask).block_until_ready()
-        t_fa = (time.perf_counter() - t0) / 5
-        t0 = time.perf_counter()
-        for _ in range(5):
-            dn(q, k, v, mask).block_until_ready()
-        t_dn = (time.perf_counter() - t0) / 5
-        results.append(dict(shape=[b, l, h, d], max_abs_err=err,
-                            grad_max_abs_err=gerr,
-                            pallas_ms=round(t_fa * 1e3, 2),
-                            xla_dense_ms=round(t_dn * 1e3, 2)))
+
+        best_fa, med_fa = _time_loop(
+            _fb_loop(lambda a, b_, c: flash_attention(a, b_, c, mask),
+                     N_SCAN), (q, k, v), REPS, N_SCAN)
+        best_dn, med_dn = _time_loop(
+            _fb_loop(lambda a, b_, c: dense_bf16(a, b_, c, mask),
+                     N_SCAN), (q, k, v), REPS, N_SCAN)
+        results.append(dict(
+            tag=tag, shape=[b, l, h, d], max_abs_err=err,
+            grad_max_abs_err=gerr,
+            pallas_fb_ms=round(best_fa * 1e3, 4),
+            xla_dense_fb_ms=round(best_dn * 1e3, 4),
+            pallas_fb_ms_median=round(med_fa * 1e3, 4),
+            xla_dense_fb_ms_median=round(med_dn * 1e3, 4),
+            speedup=round(best_dn / best_fa, 3)))
         print(results[-1], flush=True)
+
     payload = {
         "device": str(jax.devices()[0]),
+        "n_scan_iters": N_SCAN,
+        "reps": REPS,
         "results": results,
-        "note": ("NUMERICS artifact only: max_abs_err (bf16 rounding "
-                 "scale) is the hardware-correctness record. The *_ms "
-                 "columns are single-dispatch wall times on a tunneled "
-                 "chip = ~100 ms dispatch floor, NOT kernel time. The "
-                 "authoritative speed record is MODEL_BENCH.json "
-                 "(in-model multi-step scan) and STEP_PROFILE.json "
-                 "(device-busy per-op times)."),
+        "note": ("Kernel-level record: *_fb_ms = per-iteration wall time "
+                 "of ONE attention forward+backward, from a lax.scan of "
+                 "{} iterations per dispatch (best of {} dispatches; "
+                 "median column shows host spread) — the ~100 ms tunneled "
+                 "dispatch floor is amortized out. max_abs_err / "
+                 "grad_max_abs_err (bf16 rounding scale) remain the "
+                 "hardware-correctness record vs the fp32 dense "
+                 "reference. speedup > 1 means the pallas kernels beat "
+                 "XLA's fused dense attention at that shape; the auto "
+                 "selection (models/attention.resolve_auto_impl) follows "
+                 "the measured map incl. the in-model numbers in "
+                 "MODEL_BENCH.json.").format(N_SCAN, REPS),
     }
     with open(os.path.join(ROOT, "FLASH_ATTENTION_BENCH.json"), "w") as f:
         json.dump(payload, f, indent=1)
