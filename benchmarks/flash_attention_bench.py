@@ -89,6 +89,8 @@ def main():
     for (tag, b, l, h, d) in [("base_L128", 8, 128, 12, 64),
                               ("base_L512", 32, 512, 12, 64),
                               ("large_L512", 12, 512, 16, 64),
+                              ("base_L768", 16, 768, 12, 64),
+                              ("base_L896", 12, 896, 12, 64),
                               ("base_L2048", 4, 2048, 12, 64)]:
         q = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.bfloat16)
         k = jnp.asarray(g.standard_normal((b, l, h, d)), jnp.bfloat16)

@@ -219,12 +219,19 @@ def main():
     else:
         # bert_large @ L=512 is the reference's own headline pretraining
         # config (phase2), served by the round-5 single-block kernels
-        # (auto picks flash for 256 <= l_pad <= 512 and l_pad >= 1024,
-        # dense at the shortest bins and in the 512 < l_pad < 1024 band —
-        # attention.resolve_auto_impl); base @ 1024 pins the online
-        # kernels' side; base @ 2048 exercises the long-context story.
+        # (auto picks flash for 256 <= l_pad <= 896 and l_pad >= 1024,
+        # dense only at the shortest bins — attention.resolve_auto_impl);
+        # base @ 1024 pins the online kernels' side; base @ 2048
+        # exercises the long-context story.
+        # bert_base @ 768 pins the former in-between band (one-row
+        # single-block cells); bert_large @ B=16 is the flash-only tuned
+        # optimum — the kernels skip dense's ~100 MB/layer probs
+        # residual, which flips the batch sweep (dense peaks at B=12,
+        # flash at B=16: 56.2% vs 53.8%@B=20, 51.3%@B=24, round-5 sweep).
         configs = [("bert_base", 32, 512, 96), ("bert_base", 8, 1024, 48),
-                   ("bert_base", 4, 2048, 48), ("bert_large", 12, 512, 128)]
+                   ("bert_base", 4, 2048, 48), ("bert_base", 16, 768, 64),
+                   ("bert_large", 12, 512, 128),
+                   ("bert_large", 16, 512, 96)]
         base = {}
 
     results = []
@@ -236,7 +243,8 @@ def main():
     for family, batch, seq_len, cfg_steps in configs:
         n_steps = args.n_steps or cfg_steps
         for impl, gather in variants:
-            if not gather and (family, seq_len) != ("bert_large", 512):
+            if not gather and (family, batch,
+                               seq_len) != ("bert_large", 12, 512):
                 continue
             make = getattr(BertConfig, family)
             cfg = make(

@@ -32,9 +32,14 @@ def resolve_auto_impl(seq_len, blockwise_ok, attention_dropout,
     kernels keep their long-L wins (L=1024: 36.3 vs 34.0; L=2048: 35.6
     vs 28.0, round 4). Dense stays ahead only at L <= 128 (52.1 vs 42.1
     at the shortest bin) where per-kernel-launch overhead dominates.
-    In the band BETWEEN the regimes (512 < L_pad < 1024) the single-block
-    kernels disengage and the online kernels measurably lose (L=768:
-    33.9 vs 38.1, round-5 probe), so dense holds it. Flash is picked
+    The former in-between band (512 < L_pad < 1024, where the ONLINE
+    kernels lose — L=768 in-model probe 33.9 vs 38.1) was taken by
+    extending the single-block kernels to l_pad <= 896 with one-row
+    cells: kernel-level 1.71x over dense at L=768 and 1.51x at L=896,
+    in-model 46.4 vs 38.7 MFU at L=768 (FLASH_ATTENTION_BENCH.json /
+    MODEL_BENCH.json), so only L_pad <= 128 remains dense at the
+    standard head_dim 64 (wider heads keep the 512 bound — _use_onekv).
+    Flash is picked
     only when it computes the SAME math as dense (it skips
     attention-prob dropout, so dropout > 0 pins dense — unless the call
     is deterministic, where dropout is a no-op and flash is identical):

@@ -1,17 +1,19 @@
 """Pallas fused attention for TPU — two regimes behind one entry point.
 
-Short sequences (L_pad <= 512, the reference's headline pretraining
-regime — /root/reference/lddl/dask/bert/pretrain.py:627-637): the
+Short sequences (L_pad <= 896 at the standard head_dim 64; the
+reference's headline pretraining regime L=512 —
+/root/reference/lddl/dask/bert/pretrain.py:627-637 — sits here): the
 "single-block" kernels. The whole L x L score matrix for one (batch,
 head) row fits VMEM, so the forward computes an ordinary (not online)
 softmax in one pass, and the backward is ONE fused kernel that
 recomputes P once and emits dQ, dK, dV together (5 matmuls vs the
 two-kernel online recipe's 7). Cells are fat: ``nbh`` (batch, head)
 rows per grid cell (same batch row, so the mask/allowed matrix is built
-once per cell), which amortizes per-cell overheads that dominate at
-short L — this is what makes the pallas kernel BEAT XLA's fused dense
-attention at L = 512 (round-5 micro-bench + MODEL_BENCH.json), where
-rounds 3-4 lost to it.
+once per cell; one row above L_pad 512, where the temporaries grow),
+which amortizes per-cell overheads that dominate at short L — this is
+what makes the pallas kernel BEAT XLA's fused dense attention from
+L_pad 256 through 896 (FLASH_ATTENTION_BENCH.json +
+MODEL_BENCH.json), where rounds 3-4 lost to it at 512 and below.
 
 Long sequences: the flash-style online-softmax kernels. Per (batch,
 head), Q blocks stream through VMEM while the kernel walks K/V blocks
@@ -226,7 +228,7 @@ def flash_attention_fwd(q, k, v, kv_mask, interpret=None, q_mask=None):
         q, k, v, kv_mask, q_mask)
     scale = 1.0 / (d ** 0.5)
     if _use_onekv(l_pad, d):
-        nbh = _nbh_for(h)
+        nbh = _nbh_for(h, l_pad)
         spec, spec_mask, spec_row = _onekv_specs(nbh, l_pad, d, h)
         out, lse = pl.pallas_call(
             functools.partial(_onekv_fwd_kernel, scale=scale, nbh=nbh),
@@ -290,7 +292,7 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
         axis=-1).reshape(b * h, 1, l_pad)
 
     if _use_onekv(l_pad, d):
-        nbh = _nbh_for(h)
+        nbh = _nbh_for(h, l_pad)
         spec, spec_mask, spec_row = _onekv_specs(nbh, l_pad, d, h)
         dq, dk, dv = pl.pallas_call(
             functools.partial(_onekv_bwd_kernel, scale=scale, nbh=nbh),
@@ -359,7 +361,7 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
 
 
 # ---------------------------------------------------------------------------
-# Single-block ("onekv") kernels: the L_pad <= 512 regime.
+# Single-block ("onekv") kernels: the L_pad <= ONEKV_MAX_L_PAD regime.
 #
 # Per grid cell, ``nbh`` consecutive (batch, head) rows — all of the SAME
 # batch row (dispatch guarantees nbh divides num_heads) — are processed with
@@ -373,7 +375,7 @@ def flash_attention_bwd(q, k, v, kv_mask, out, lse, ct, interpret=None,
 # ---------------------------------------------------------------------------
 
 
-ONEKV_MAX_L_PAD = 512
+ONEKV_MAX_L_PAD = 896
 
 
 def pad_seq_len(l):
@@ -383,8 +385,15 @@ def pad_seq_len(l):
 
 def _use_onekv(l_pad, d):
     """Single-block dispatch: the [L, L] per-row score matrix and the fused
-    backward's temporaries must fit VMEM alongside nbh rows of blocks."""
-    return l_pad <= ONEKV_MAX_L_PAD and d <= 128
+    backward's temporaries must fit VMEM alongside nbh rows of blocks
+    (nbh drops to 1 above 512 — see _nbh_for; 896 is the largest l_pad
+    whose fused-backward temporaries, ~3 fp32 [L, L] + one bf16 [L, L],
+    still compile at nbh=1; 1024 does not fit). The extended 640-896
+    range is compile-validated at head_dim 64 only (every BERT/BART
+    preset) — wider heads double the per-row blocks on top of the ~11 MB
+    of [896, 896] temporaries, so they keep the conservative 512 bound."""
+    max_l = ONEKV_MAX_L_PAD if d <= 64 else 512
+    return l_pad <= max_l and d <= 128
 
 
 def single_block_serves(seq_len, head_dim):
@@ -397,9 +406,13 @@ def single_block_serves(seq_len, head_dim):
     return l_pad >= 256 and _use_onekv(l_pad, head_dim)
 
 
-def _nbh_for(h):
-    """Rows per cell: largest of 4/2/1 dividing num_heads, so every cell's
-    rows share one batch row (mask built once per cell)."""
+def _nbh_for(h, l_pad):
+    """Rows per cell: largest of 4/2/1 dividing num_heads so every cell's
+    rows share one batch row (mask built once per cell) — but 1 above
+    l_pad 512, where a single row's [L, L] fp32 temporaries already take
+    ~2.5 MB each and unrolled multi-row cells blow VMEM."""
+    if l_pad > 512:
+        return 1
     return 4 if h % 4 == 0 else (2 if h % 2 == 0 else 1)
 
 

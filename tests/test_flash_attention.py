@@ -65,23 +65,85 @@ def test_jit_composes():
 
 
 def test_onekv_dispatch_boundary():
-    """L_pad <= 512 runs the single-block kernels, above runs online."""
+    """L_pad <= 896 runs the single-block kernels (nbh=1 above 512),
+    above runs online."""
     from lddl_tpu.ops.flash_attention import _use_onekv, _nbh_for
 
     assert _use_onekv(512, 64)       # the reference's headline config
     assert _use_onekv(128, 64)
-    assert not _use_onekv(640, 64)   # online regime
-    assert not _use_onekv(1024, 64)
-    assert _nbh_for(16) == 4 and _nbh_for(12) == 4   # bert head counts
-    assert _nbh_for(6) == 2 and _nbh_for(7) == 1
+    assert _use_onekv(640, 64) and _use_onekv(896, 64)   # the former band
+    assert not _use_onekv(1024, 64)  # online regime
+    # the 640-896 extension is compile-validated at head_dim 64 only:
+    # wider heads keep the conservative 512 bound (VMEM)
+    assert _use_onekv(512, 128) and not _use_onekv(640, 128)
+    assert not _use_onekv(512, 256)  # d > 128 is always online
+    assert _nbh_for(16, 512) == 4 and _nbh_for(12, 512) == 4  # bert heads
+    assert _nbh_for(6, 512) == 2 and _nbh_for(7, 512) == 1
+    # single-row cells above 512 (VMEM: [L,L] fp32 temporaries)
+    assert _nbh_for(16, 640) == 1 and _nbh_for(12, 896) == 1
 
 
-def test_online_path_matches_dense_above_512():
-    """L=600 (l_pad=640 > ONEKV_MAX_L_PAD): the online-softmax kernels,
-    forward AND gradients vs the dense reference."""
+def test_onekv_band_matches_dense():
+    """L=600 (l_pad=640): the nbh=1 single-block cells that took over the
+    former 512 < l_pad < 1024 dense band — forward and gradients vs the
+    dense reference."""
     q, k, v, _ = _inputs(l=600, seed=5)
     mask = np.ones((2, 600), np.int32)
     mask[0, 550:] = 0
+    mask = jnp.asarray(mask)
+
+    out = flash_attention(q, k, v, mask)
+    ref = dense_attention_reference(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+    def loss_f(q, k, v):
+        return (flash_attention(q, k, v, mask) ** 2).sum()
+
+    def loss_d(q, k, v):
+        return (dense_attention_reference(q, k, v, mask) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_online_nondividing_blocks_match_dense():
+    """l_pad=640 with head_dim=256 (d > 128 fails the single-block gate,
+    so this is the reachable online path in the 512-896 range): exercises
+    _block_sizes' power-of-two halving fallback (640 % 256 != 0 ->
+    tq=tk=128), forward and gradients vs the dense reference."""
+    q, k, v, _ = _inputs(l=600, h=2, d=256, seed=13)
+    mask = np.ones((2, 600), np.int32)
+    mask[0, 550:] = 0
+    mask = jnp.asarray(mask)
+
+    out = flash_attention(q, k, v, mask)
+    ref = dense_attention_reference(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=3e-5, atol=3e-5)
+
+    def loss_f(q, k, v):
+        return (flash_attention(q, k, v, mask) ** 2).sum()
+
+    def loss_d(q, k, v):
+        return (dense_attention_reference(q, k, v, mask) ** 2).sum()
+
+    gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_online_path_matches_dense_above_896():
+    """L=1000 (l_pad=1024 > ONEKV_MAX_L_PAD): the online-softmax kernels,
+    forward AND gradients vs the dense reference."""
+    q, k, v, _ = _inputs(l=1000, seed=5)
+    mask = np.ones((2, 1000), np.int32)
+    mask[0, 900:] = 0
     mask = jnp.asarray(mask)
 
     out = flash_attention(q, k, v, mask)

@@ -180,9 +180,10 @@ def test_attention_auto_selection(tiny_cfg):
     assert resolve_auto_impl(128, True, 0.0, head_dim=64) == "dense"
     assert resolve_auto_impl(256, True, 0.0, head_dim=64) == "flash"
     assert resolve_auto_impl(512, True, 0.0, head_dim=64) == "flash"
-    # between the regimes the single-block kernels disengage and the
-    # online kernels lose to dense (L=768 probe, round 5)
-    assert resolve_auto_impl(768, True, 0.0, head_dim=64) == "dense"
+    # the former in-between band: single-block kernels extended to
+    # l_pad <= 896 with one-row cells (1.40x over dense at 768, round 5)
+    assert resolve_auto_impl(768, True, 0.0, head_dim=64) == "flash"
+    assert resolve_auto_impl(896, True, 0.0, head_dim=64) == "flash"
     assert resolve_auto_impl(1024, True, 0.0, head_dim=64) == "flash"
     # the long branch reasons in l_pad: 960 pads to 1024 (online win)
     assert resolve_auto_impl(960, True, 0.0, head_dim=64) == "flash"
