@@ -205,13 +205,14 @@ def main():
     reps = args.reps or 2
 
     # Per-row (batch, n_steps) are TUNED for wall MFU on the one v5e chip
-    # (round-5 sweep, /tmp logs summarized in the commit): the optimizer's
-    # ~10 ms/step fixed elementwise cost and the ~5.5 ms scan-iteration +
-    # dispatch overheads amortize with batch and steps-per-dispatch —
-    # bert_large L=512 measured 42.3% at (B=8, n=32) vs 53.5% at
-    # (B=12, n=128) with identical per-step math. B=16/24 LOSE to B=12 at
-    # L=512 (45.5/43.9%): bigger is not monotonically better, tune per
-    # shape.
+    # (round-5 sweeps): the optimizer's ~10 ms/step fixed elementwise cost
+    # and the ~5.5 ms scan-iteration + dispatch overheads amortize with
+    # batch and steps-per-dispatch — bert_large L=512 measured 42.3% at
+    # (B=8, n=32) vs 53.5% at (B=12, n=128) with identical per-step math.
+    # The batch optimum is IMPL-SPECIFIC: dense peaks at B=12 (B=16/24
+    # lose, 45.5/43.9% — its [B,H,L,L] probs residual eats HBM) while
+    # flash keeps scaling to B=16 (56.2% > 53.8% at B=20 > 51.3% at
+    # B=24); tune per shape AND per impl.
     if args.quick:
         configs = [("bert_base", 4, 64, 4), ("bert_base", 4, 128, 4)]
         base = dict(vocab_size=1024, hidden_size=64, num_layers=2,

@@ -98,6 +98,10 @@ def main():
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--no-gather", action="store_true",
                    help="profile the full-sequence MLM head instead")
+    p.add_argument("--attention-impl", default="auto",
+                   choices=["auto", "dense", "flash"],
+                   help="auto (the production default) resolves per the "
+                        "measured map in attention.resolve_auto_impl")
     p.add_argument("--top", type=int, default=25)
     p.add_argument("--out", default=os.path.join(ROOT, "STEP_PROFILE.json"))
     args = p.parse_args()
@@ -116,6 +120,7 @@ def main():
     mesh = make_mesh({"dp": 1}, devices=[device])
     cfg = getattr(BertConfig, args.model)(
         attention_dropout=0.0, mlm_gather=not args.no_gather,
+        attention_impl=args.attention_impl,
         max_position_embeddings=max(512, args.seq_len))
     batch_np = fake_pretrain_batch(cfg.vocab_size, args.batch, args.seq_len,
                                    seed=7, segment_split=True)
@@ -179,6 +184,7 @@ def main():
         "device_kind": kind,
         "model": args.model,
         "batch": args.batch,
+        "attention_impl": args.attention_impl,
         "seq_len": args.seq_len,
         "mlm_gather_positions": n_pred,
         "wall_s_incl_dispatch": round(wall_s, 3),

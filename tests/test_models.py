@@ -181,7 +181,8 @@ def test_attention_auto_selection(tiny_cfg):
     assert resolve_auto_impl(256, True, 0.0, head_dim=64) == "flash"
     assert resolve_auto_impl(512, True, 0.0, head_dim=64) == "flash"
     # the former in-between band: single-block kernels extended to
-    # l_pad <= 896 with one-row cells (1.40x over dense at 768, round 5)
+    # l_pad <= 896 with one-row cells (1.71x kernel-level over dense at
+    # L=768, FLASH_ATTENTION_BENCH.json; 46.4 vs 38.7 MFU in-model)
     assert resolve_auto_impl(768, True, 0.0, head_dim=64) == "flash"
     assert resolve_auto_impl(896, True, 0.0, head_dim=64) == "flash"
     assert resolve_auto_impl(1024, True, 0.0, head_dim=64) == "flash"
