@@ -229,8 +229,12 @@ def main():
         # optimum — the kernels skip dense's ~100 MB/layer probs
         # residual, which flips the batch sweep (dense peaks at B=12,
         # flash at B=16: 56.2% vs 53.8%@B=20, 51.3%@B=24, round-5 sweep).
+        # bert_base @ B=64 is the flash batch optimum (49.3@32 < 49.6@48
+        # < 50.7@64; B=96 crashes the worker — HBM limit with the
+        # stacked multi-step batches).
         configs = [("bert_base", 32, 512, 96), ("bert_base", 8, 1024, 48),
                    ("bert_base", 4, 2048, 48), ("bert_base", 16, 768, 64),
+                   ("bert_base", 64, 512, 48),
                    ("bert_large", 12, 512, 128),
                    ("bert_large", 16, 512, 96)]
         base = {}
