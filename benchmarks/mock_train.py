@@ -94,6 +94,13 @@ def attach_args():
                    "per-seq-length dense/flash selection)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize layers (--with-model)")
+    p.add_argument("--on-corrupt", choices=("fail", "quarantine"),
+                   default=None,
+                   help="startup shard-integrity policy against the "
+                        ".manifest.json: fail = refuse to start naming the "
+                        "corrupt shard(s); quarantine = exclude them "
+                        "loudly and run on the survivors (default: "
+                        "$LDDL_TPU_ON_CORRUPT, then fail)")
     return p
 
 
@@ -147,6 +154,7 @@ def main():
             base_seed=args.seed,
             start_epoch=args.start_epoch,
             return_raw_samples=args.debug,
+            on_corrupt=args.on_corrupt,
         )
     else:
         loader = get_bert_pretrain_data_loader(
@@ -161,6 +169,7 @@ def main():
             base_seed=args.seed,
             start_epoch=args.start_epoch,
             return_raw_samples=args.debug,
+            on_corrupt=args.on_corrupt,
         )
     if args.debug:
         from lddl_tpu.preprocess import get_tokenizer

@@ -35,11 +35,12 @@ Differences from the reference (improvements, not drift):
 import os
 
 import pyarrow as pa
-import pyarrow.parquet as pq
 
 from ..preprocess.binning import DEFAULT_PARQUET_COMPRESSION
 
 from ..parallel.distributed import LocalCommunicator
+from ..resilience.integrity import build_manifest
+from ..resilience.io import read_table, write_table_atomic
 from ..utils.fs import (
     get_all_bin_ids,
     get_all_parquets_under,
@@ -100,8 +101,8 @@ class _Shard:
         self._count("rows_written", num_samples)
         if table is not None:
             assert table.num_rows == num_samples
-            pq.write_table(table, path,
-                           compression=DEFAULT_PARQUET_COMPRESSION)
+            write_table_atomic(table, path,
+                               compression=DEFAULT_PARQUET_COMPRESSION)
 
     def _load(self, num_samples, with_table):
         """Remove rows, consuming input files from the end first, then
@@ -118,7 +119,7 @@ class _Shard:
                         src.num_samples)
             src_table = None
             if with_table:
-                src_table = pq.read_table(src.path)
+                src_table = read_table(src.path)
                 assert src_table.num_rows == src.num_samples
                 tables.append(src_table.slice(0, take))
             if take < src.num_samples:
@@ -162,10 +163,10 @@ class _Shard:
         self._count("rows_reread", sum(f.num_samples for f in parts))
         self._count("rows_written", n)
         if i_am_owner:
-            table = pa.concat_tables([pq.read_table(f.path) for f in sources])
+            table = pa.concat_tables([read_table(f.path) for f in sources])
             assert table.num_rows == n
-            pq.write_table(table, self.out_path,
-                       compression=DEFAULT_PARQUET_COMPRESSION)
+            write_table_atomic(table, self.out_path,
+                               compression=DEFAULT_PARQUET_COMPRESSION)
             for f in parts:
                 os.remove(f.path)
         self.final_file = File(self.out_path, n)
@@ -312,6 +313,9 @@ def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None,
     if comm.rank == 0:
         write_num_samples_cache(out_dir, counts)
     comm.barrier()
+    # Integrity manifest next to .num_samples.json: per-shard byte length
+    # + CRC32, verified by the loader at startup (rank-strided checksums).
+    build_manifest(out_dir, comm=comm, log=log)
     return counts
 
 

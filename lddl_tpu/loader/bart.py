@@ -27,7 +27,8 @@ from ..utils import rng as lrng
 from ..utils.fs import get_all_parquets_under
 from ..utils.logging import DatasetLogger
 from .dataloader import DataLoader
-from .datasets import ParquetDataset
+from .datasets import (ParquetDataset, annotate_quarantine,
+                       verified_shard_paths)
 
 
 def decode_record_batch(b):
@@ -200,8 +201,11 @@ def get_bart_pretrain_data_loader(
     prefetch=2,
     comm=None,
     worker_mode="thread",
+    on_corrupt=None,
 ):
-    """BART denoising loader over ``{sentences}`` shards at ``path``."""
+    """BART denoising loader over ``{sentences}`` shards at ``path``.
+    ``on_corrupt``: startup shard-integrity policy, see
+    get_bert_pretrain_data_loader."""
     import logging
     if tokenizer is None:
         from ..preprocess.tokenizer import get_tokenizer
@@ -215,19 +219,29 @@ def get_bart_pretrain_data_loader(
     file_paths = get_all_parquets_under(path)
     if not file_paths:
         raise ValueError("no parquet shards under {}".format(path))
-    dataset = ParquetDataset(
-        file_paths,
-        base_seed=base_seed,
-        start_epoch=start_epoch,
-        dp_rank=dp_rank,
-        num_dp_groups=num_dp_groups,
-        num_workers=num_workers,
-        shuffle_buffer_size=shuffle_buffer_size,
-        shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
-        decode_record_batch=decode_record_batch,
-        comm=comm,
-        logger=logger,
-    )
+    n_before = len(file_paths)
+    file_paths = verified_shard_paths(path, file_paths,
+                                      on_corrupt=on_corrupt, logger=logger,
+                                      comm=comm)
+    n_quarantined = n_before - len(file_paths)
+    try:
+        dataset = ParquetDataset(
+            file_paths,
+            base_seed=base_seed,
+            start_epoch=start_epoch,
+            dp_rank=dp_rank,
+            num_dp_groups=num_dp_groups,
+            num_workers=num_workers,
+            shuffle_buffer_size=shuffle_buffer_size,
+            shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+            decode_record_batch=decode_record_batch,
+            comm=comm,
+            logger=logger,
+        )
+    except ValueError as e:
+        if n_quarantined:
+            raise annotate_quarantine(e, n_quarantined) from e
+        raise
     collate = None if return_raw_samples else BartCollate(
         tokenizer,
         max_seq_length=max_seq_length,

@@ -25,7 +25,8 @@ from ..utils.fs import (
 )
 from ..utils.logging import DatasetLogger
 from .dataloader import Binned, DataLoader
-from .datasets import ParquetDataset
+from .datasets import (ParquetDataset, annotate_quarantine,
+                       verified_shard_paths)
 
 
 def decode_record_batch(b):
@@ -430,8 +431,14 @@ def get_bert_pretrain_data_loader(
     pack_horizon=None,
     pack_allow_uneven_epochs=False,
     worker_mode="thread",
+    on_corrupt=None,
 ):
     """Build the BERT pretraining loader over balanced shards at ``path``.
+
+    ``on_corrupt`` sets the startup shard-integrity policy ("fail" |
+    "quarantine"; None defers to LDDL_TPU_ON_CORRUPT then "fail") — shards
+    are checked against the ``.manifest.json`` their producer published;
+    quarantine excludes corrupt shards loudly and continues on the rest.
 
     Auto-detects binned vs unbinned from the shard filenames and static vs
     dynamic masking from the parquet schema
@@ -464,7 +471,19 @@ def get_bert_pretrain_data_loader(
     file_paths = get_all_parquets_under(path)
     if not file_paths:
         raise ValueError("no parquet shards under {}".format(path))
-    bin_ids = get_all_bin_ids(file_paths)
+    n_before = len(file_paths)
+    file_paths = verified_shard_paths(path, file_paths,
+                                      on_corrupt=on_corrupt, logger=logger,
+                                      comm=comm)
+    n_quarantined = n_before - len(file_paths)
+    try:
+        bin_ids = get_all_bin_ids(file_paths)
+    except ValueError as e:
+        if n_quarantined:
+            # Quarantine swallowed a whole bin: point the operator at the
+            # corrupt shards just logged, not at the preprocessor.
+            raise annotate_quarantine(e, n_quarantined) from e
+        raise
 
     packing = pack_seq_length is not None or pack_rows is not None
     if packing:
@@ -493,20 +512,27 @@ def get_bert_pretrain_data_loader(
             raise ValueError("return_raw_samples and packing are exclusive")
 
     def make_dataset(paths, transform=None):
-        return ParquetDataset(
-            paths,
-            base_seed=base_seed,
-            start_epoch=start_epoch,
-            dp_rank=dp_rank,
-            num_dp_groups=num_dp_groups,
-            num_workers=num_workers,
-            shuffle_buffer_size=shuffle_buffer_size,
-            shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
-            decode_record_batch=decode_record_batch,
-            transform=transform,
-            comm=comm,
-            logger=logger,
-        )
+        try:
+            return ParquetDataset(
+                paths,
+                base_seed=base_seed,
+                start_epoch=start_epoch,
+                dp_rank=dp_rank,
+                num_dp_groups=num_dp_groups,
+                num_workers=num_workers,
+                shuffle_buffer_size=shuffle_buffer_size,
+                shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+                decode_record_batch=decode_record_batch,
+                transform=transform,
+                comm=comm,
+                logger=logger,
+            )
+        except ValueError as e:
+            # Divisibility/balance errors after a quarantine must name
+            # the quarantine, not (only) the shard/dp-group arithmetic.
+            if n_quarantined:
+                raise annotate_quarantine(e, n_quarantined) from e
+            raise
 
     def make_collate(fixed_seq_length):
         if return_raw_samples:

@@ -20,6 +20,7 @@ torch.utils.data.DataLoader worker *processes*
 import queue
 import threading
 
+from ..resilience import faults
 from ..utils import rng as lrng
 from ..utils.logging import DatasetLogger
 
@@ -42,6 +43,10 @@ def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
             collate = collate_fn or (lambda b: b)
 
         def put_batch(b):
+            # Chaos-harness site: a "worker:kill" fault SIGKILLs this
+            # worker here, before the batch is enqueued (supervision in
+            # DataLoader._iter_process restarts + replays it).
+            faults.fault_point("worker", "w{}".format(worker_idx))
             out_q.put(("batch", pickle.dumps(collate(b), protocol=-1)))
 
         batch = []
@@ -98,6 +103,7 @@ class DataLoader:
         self._prefetch = max(1, prefetch)
         self._worker_mode = worker_mode
         self._procs = self._cmd_qs = self._out_qs = None
+        self._local_qs = self._pump_stops = None
         self._finalizer = None
         self._pool_gen = 0
         self._epoch_active = False
@@ -217,6 +223,10 @@ class DataLoader:
                     p.terminate()
             raise
         self._procs = procs
+        self._local_qs = [None] * n
+        self._pump_stops = [None] * n
+        for w in range(n):
+            self._start_pump(w)
         self._pool_gen += 1
         # GC safety net: daemon workers die with the interpreter anyway,
         # but a finalizer releases them as soon as the loader is dropped.
@@ -248,10 +258,114 @@ class DataLoader:
             except Exception:  # noqa: BLE001 - queue may be broken
                 pass
         self._shutdown_procs(self._procs, grace_s=2)
+        if self._pump_stops is not None:
+            for stop in self._pump_stops:
+                if stop is not None:
+                    stop.set()
         if self._finalizer is not None:
             self._finalizer.detach()
         self._procs = self._cmd_qs = self._out_qs = None
+        self._local_qs = self._pump_stops = None
         self._finalizer = None
+
+    # A dead process worker (OOM killer, preemption, segfault in native
+    # code) is restarted at most this many times per worker per epoch;
+    # the second death of the same worker fails fast with a named error.
+    _MAX_WORKER_RESTARTS = 1
+    # How long a queue get waits before re-checking worker liveness.
+    _POLL_TIMEOUT_S = 5.0
+
+    @staticmethod
+    def _pump_worker_queue(mp_q, local_q, stop):
+        """Forward worker output from the mp queue onto an in-process
+        queue from a SACRIFICIAL daemon thread. mp.Queue.get's timeout
+        covers only the initial poll — once a frame header arrives, the
+        payload read blocks until complete, and a frame torn by a SIGKILL
+        mid-put never completes (the parent holds a write end, so no EOF
+        either). The training loop therefore must never read the pipe
+        directly: if this thread wedges on a torn frame, the supervisor
+        simply abandons it with the dead worker's queues. The local queue
+        is size-1 so the mp queue's prefetch bound still backpressures
+        the worker."""
+        while not stop.is_set():
+            try:
+                item = mp_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            except Exception:  # noqa: BLE001 - torn pipe / unpickling
+                item = ("pump_torn", None)
+            while not stop.is_set():
+                try:
+                    local_q.put(item, timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
+            if item[0] == "pump_torn":
+                return
+
+    def _start_pump(self, w):
+        stop = threading.Event()
+        local_q = queue.Queue(maxsize=1)
+        t = threading.Thread(target=self._pump_worker_queue,
+                             args=(self._out_qs[w], local_q, stop),
+                             daemon=True)
+        t.start()
+        self._local_qs[w] = local_q
+        self._pump_stops[w] = stop
+
+    def _restart_worker(self, w):
+        """Replace dead worker ``w`` with a fresh spawn on FRESH queues
+        and a fresh pump thread (a SIGKILL mid-``put`` can leave a torn
+        frame in the old queue — possibly with the old pump wedged on it —
+        so both are abandoned wholesale). The pool lists mutate in place
+        so the GC finalizer and any local aliases track the replacement."""
+        import multiprocessing
+        ctx = multiprocessing.get_context("spawn")
+        self._pump_stops[w].set()
+        for q in (self._cmd_qs[w], self._out_qs[w]):
+            try:
+                q.close()
+                q.cancel_join_thread()
+            except Exception:  # noqa: BLE001 - queue may already be broken
+                pass
+        self._cmd_qs[w] = ctx.Queue()
+        self._out_qs[w] = ctx.Queue(maxsize=self._prefetch)
+        p = ctx.Process(
+            target=_persistent_worker_main,
+            args=(self.dataset, w, self.batch_size, self._user_collate,
+                  self._cmd_qs[w], self._out_qs[w]),
+            daemon=True)
+        p.start()
+        old = self._procs[w]
+        self._procs[w] = p
+        self._start_pump(w)
+        try:
+            old.join(timeout=1)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _handle_worker_death(self, w, epoch, rng_spec, restarts, served,
+                             skip):
+        """Supervision policy: restart a dead worker once and replay its
+        pure (seed, epoch, dp, worker) stream deterministically — the
+        first ``served[w]`` batches are discarded unopened, so the
+        consumer-visible batch sequence is unchanged. A second death of
+        the same worker raises a named error instead of looping."""
+        import warnings
+        code = self._procs[w].exitcode
+        restarts[w] += 1
+        if restarts[w] > self._MAX_WORKER_RESTARTS:
+            raise RuntimeError(
+                "loader worker {} died again after a restart (last exit "
+                "code {}); failing fast — a worker that keeps dying needs "
+                "a human, not another retry".format(w, code))
+        warnings.warn(
+            "loader worker {} died (exit code {}); restarting it once and "
+            "replaying its deterministic stream (discarding {} already-"
+            "served batch(es))".format(w, code, served[w]), stacklevel=3)
+        self._restart_worker(w)
+        self._cmd_qs[w].put(("epoch", epoch, rng_spec))
+        skip[w] = served[w]
 
     def _iter_process(self):
         import pickle
@@ -268,37 +382,69 @@ class DataLoader:
         self._ensure_worker_pool()
         gen = self._pool_gen
         self._epoch_active = True
-        procs, out_qs = self._procs, self._out_qs
+        procs, local_qs = self._procs, self._local_qs
         n = len(procs)
+
+        def rng_spec(w):
+            return ((ds.base_seed, self._COLLATE_RNG_TAG, epoch, ds.dp_rank,
+                     w) if rng else None)
+
         for w in range(n):
-            self._cmd_qs[w].put(
-                ("epoch", epoch,
-                 (ds.base_seed, self._COLLATE_RNG_TAG, epoch, ds.dp_rank, w)
-                 if rng else None))
+            self._cmd_qs[w].put(("epoch", epoch, rng_spec(w)))
         live = list(range(n))
+        served = [0] * n    # batches yielded to the consumer, per worker
+        restarts = [0] * n  # deaths survived this epoch, per worker
+        skip = [0] * n      # replayed batches to discard after a restart
         try:
             while live:
                 for w in list(live):
+                    payload = None
                     while True:
-                        # Timed get + liveness check: a worker killed
-                        # without enqueueing (OOM killer, segfault in
-                        # native code) must raise here, not hang the
-                        # training loop forever.
+                        # Timed get + liveness check against the PUMPED
+                        # in-process queue (never the mp pipe itself — see
+                        # _pump_worker_queue): a worker killed without
+                        # enqueueing (OOM killer, segfault in native code)
+                        # must be detected here, not hang the training
+                        # loop forever. Batches already pumped when the
+                        # worker died are a valid stream prefix and are
+                        # consumed normally first.
                         try:
-                            kind, payload = out_qs[w].get(timeout=5.0)
-                            break
+                            kind, payload = local_qs[w].get(
+                                timeout=self._POLL_TIMEOUT_S)
                         except queue.Empty:
-                            if not procs[w].is_alive():
+                            if procs[w].is_alive():
+                                continue
+                            self._handle_worker_death(
+                                w, epoch, rng_spec(w), restarts, served,
+                                skip)
+                            continue
+                        if kind == "pump_torn":
+                            # A SIGKILL mid-put tore the queue pipe; only
+                            # a dead worker excuses that.
+                            if procs[w].is_alive():
                                 raise RuntimeError(
-                                    "loader worker {} died (exit code {}) "
-                                    "without reporting".format(
-                                        w, procs[w].exitcode))
+                                    "loader worker {} output queue broke "
+                                    "while the worker is alive".format(w))
+                            self._handle_worker_death(
+                                w, epoch, rng_spec(w), restarts, served,
+                                skip)
+                            continue
+                        if kind == "batch" and skip[w] > 0:
+                            skip[w] -= 1  # replayed duplicate: drop unopened
+                            continue
+                        break
                     if kind == "error":
                         raise RuntimeError(
                             "loader worker {} failed:\n{}".format(w, payload))
                     if kind == "end":
+                        if skip[w] > 0:
+                            raise RuntimeError(
+                                "loader worker {} replay ended {} batch(es) "
+                                "early; its stream is not reproducing "
+                                "deterministically".format(w, skip[w]))
                         live.remove(w)
                         continue
+                    served[w] += 1
                     yield pickle.loads(payload)
         finally:
             if live:

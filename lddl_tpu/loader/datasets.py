@@ -16,16 +16,56 @@ Determinism contract (ref: lddl/torch/datasets.py:227-286):
 
 import os
 
-import pyarrow.parquet as pq
-
 from ..parallel.distributed import LocalCommunicator
+from ..resilience.io import read_table
 from ..utils import rng as lrng
 from ..utils.fs import (
     get_num_samples_of_parquet,
+    num_samples_cache_is_stale,
     read_num_samples_cache,
 )
 from ..utils.logging import DatasetLogger
 from ..utils.types import File
+
+
+def verified_shard_paths(path, file_paths, on_corrupt=None, logger=None,
+                         comm=None):
+    """Startup integrity gate shared by the loader factories: verify the
+    shards against their directories' ``.manifest.json`` (written by the
+    preprocessor/balancer; absent manifests are trusted as-is, e.g. for
+    pre-manifest data).
+
+    ``on_corrupt`` is ``"fail"`` (default, raise naming every corrupt
+    shard) or ``"quarantine"`` (exclude corrupt shards, log each exclusion
+    loudly, and return the survivors — downstream count/divisibility
+    checks then account for the exclusion explicitly). ``None`` defers to
+    ``LDDL_TPU_ON_CORRUPT`` then ``"fail"``. Raises if quarantine leaves
+    no shard at all."""
+    from ..resilience.integrity import verify_shards
+    if on_corrupt is None:
+        on_corrupt = os.environ.get("LDDL_TPU_ON_CORRUPT", "fail")
+    log = None
+    if logger is not None:
+        log = lambda msg: logger.to("rank").warning(msg)  # noqa: E731
+    good, excluded = verify_shards(file_paths, on_corrupt=on_corrupt,
+                                   log=log, comm=comm)
+    if not good:
+        raise ValueError(
+            "every parquet shard under {} was quarantined as corrupt; "
+            "re-run the producing stage".format(path))
+    return good
+
+
+def annotate_quarantine(exc, n_quarantined):
+    """Re-raise a downstream shard-set error (bin contiguity, dp-group
+    divisibility, balance) with the quarantine called out: the operator
+    must be pointed at the corrupt shards just logged, not at their
+    shard/worker configuration."""
+    return ValueError(
+        "{} (note: {} corrupt shard(s) were quarantined at startup, which "
+        "changed the shard set — re-run the producing stage to restore "
+        "them, or adjust num_dp_groups/num_workers to the surviving "
+        "count)".format(exc, n_quarantined))
 
 
 class ShuffleBuffer:
@@ -59,7 +99,9 @@ class ShuffleBuffer:
         for f in self._files:
             if self._logger is not None:
                 self._logger.to("worker").info("Reading {}".format(f.path))
-            for record_batch in pq.read_table(f.path).to_batches():
+            # Resilient shard read: transient EIO/ESTALE retries with
+            # backoff instead of killing the epoch (resilience.io).
+            for record_batch in read_table(f.path).to_batches():
                 for sample in self._decode_record_batch(record_batch):
                     if remaining <= 0:
                         return
@@ -146,13 +188,26 @@ class ParquetDataset:
     def _census(self, file_paths, comm):
         """Per-file counts from the .num_samples.json cache; strided footer
         reads + allreduce when the cache is missing/incomplete.
-        (ref: lddl/torch/datasets.py:161-195)"""
+        (ref: lddl/torch/datasets.py:161-195)
+
+        A cache whose key set mismatches the parquet basenames actually on
+        disk is STALE (e.g. a crash published it for a different shard
+        set, or shards were added/removed since): it is ignored and the
+        counts recomputed from footers, logged so the fallback is
+        visible."""
         dir_counts = {}
         for d in {os.path.dirname(p) for p in file_paths}:
             cached = read_num_samples_cache(d)
-            if cached:
-                for name, n in cached.items():
-                    dir_counts[os.path.join(d, name)] = n
+            if cached is None:
+                continue
+            if num_samples_cache_is_stale(d, cached):
+                self._logger.to("rank").warning(
+                    ".num_samples.json in {} does not match the shards on "
+                    "disk; ignoring it and recomputing counts from parquet "
+                    "footers".format(d))
+                continue
+            for name, n in cached.items():
+                dir_counts[os.path.join(d, name)] = n
         if all(p in dir_counts for p in file_paths):
             return [File(p, int(dir_counts[p])) for p in file_paths]
         counts = [0] * len(file_paths)

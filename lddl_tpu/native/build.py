@@ -13,6 +13,8 @@ import os
 import subprocess
 import tempfile
 
+from ..resilience.io import atomic_publish, atomic_write
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "lddl_native.cpp")
 TABLES = os.path.join(_DIR, "unicode_tables.h")
@@ -120,7 +122,7 @@ def ensure_built(verbose=False):
                 os.close(fd)
                 try:
                     gen_tables.generate(tmp)
-                    os.replace(tmp, TABLES)
+                    atomic_publish(tmp, TABLES)
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
@@ -143,11 +145,10 @@ def ensure_built(verbose=False):
                         if verbose:
                             print("native build failed:\n" + proc.stderr)
                         return None
-                    os.replace(tmp, LIB)  # atomic
-                    meta_tmp = tmp + ".meta"
-                    with open(meta_tmp, "w") as f:
-                        f.write(_lib_meta_tag() + "\n")
-                    os.replace(meta_tmp, LIB_META)
+                    # Durable atomic publish: on a shared tree (NFS,
+                    # prebuilt image) a torn .so would SIGBUS every host.
+                    atomic_publish(tmp, LIB)
+                    atomic_write(LIB_META, _lib_meta_tag() + "\n")
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)

@@ -19,7 +19,8 @@ import os
 
 import numpy as np
 import pyarrow as pa
-import pyarrow.parquet as pq
+
+from ..resilience.io import write_table_atomic
 
 BASE_SCHEMA = {
     "A": pa.string(),
@@ -80,7 +81,7 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
     if bin_size is None:
         schema = make_schema(masking=masking, binned=False)
         path = os.path.join(out_dir, "part.{}.parquet".format(part_id))
-        pq.write_table(
+        write_table_atomic(
             pa.table({name: columns.get(name, []) for name in schema.names},
                      schema=schema),
             path, compression=compression)
@@ -110,8 +111,11 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
                 sub[name] = [col[i] for i in idx.tolist()]
         path = os.path.join(out_dir,
                             "part.{}.parquet_{}".format(part_id, int(b)))
-        pq.write_table(pa.table(sub, schema=schema), path,
-                       compression=compression)
+        # Atomic publish (tmp + fsync + replace): a SIGKILLed worker can
+        # never leave a torn shard under its final name for the resume's
+        # exact-prefix cleanup to miss.
+        write_table_atomic(pa.table(sub, schema=schema), path,
+                           compression=compression)
         written[path] = len(idx)
     return written
 

@@ -43,6 +43,8 @@ import shutil
 import time
 
 from ..parallel.distributed import LocalCommunicator
+from ..resilience import io as rio
+from ..resilience.integrity import build_manifest
 from ..utils import rng as lrng
 from .bert import (
     BertPretrainConfig,
@@ -196,21 +198,17 @@ def _check_resume_manifest(out_dir, fingerprint, resume, rank):
                 "or start a fresh output dir".format(prior, fingerprint))
     elif rank == 0:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(fingerprint, f)
-        os.replace(tmp, path)
+        rio.atomic_write(path, json.dumps(fingerprint))
 
 
 def _ledger_write(out_dir, group, written):
-    """Atomic per-group completion record (tmp + rename): a crash between
-    part-file writes and the ledger write just redoes the group."""
+    """Durable atomic per-group completion record (resilience.io): a crash
+    between part-file writes and the ledger write just redoes the group,
+    and a crash right after the write can never durably publish a torn
+    ledger that a resume would half-trust."""
     path = _ledger_path(out_dir, group)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(written, f)
-    os.replace(tmp, path)
+    rio.atomic_write(path, json.dumps(written))
 
 
 def _ledger_read(out_dir, group):
@@ -317,8 +315,12 @@ def _spool_one_block(block, out_dir, seed, sample_ratio, nbuckets, ngroups,
                 parts.append(b" ")
                 parts.append(text)
                 parts.append(b"\n")
-        with open(os.path.join(group_dir, "w{}.txt".format(writer_tag)),
-                  "ab") as f:
+        # Guarded append (fault site "open"): spool files are O_APPEND
+        # streams, so only the OPEN retries on transient errors — a
+        # half-applied writelines is handled at the unit level (the
+        # unmarked spool is wiped and redone on resume).
+        with rio.open_append(
+                os.path.join(group_dir, "w{}.txt".format(writer_tag))) as f:
             f.writelines(parts)
 
 
@@ -340,8 +342,8 @@ def _read_group_texts(out_dir, group, nbuckets, ngroups):
         # per-line iterator overhead. Document bytes stay bytes all the
         # way into the C++ engine. Block keys stay BYTES digit strings —
         # lex order over ASCII digits matches the old str sort exactly.
-        with open(os.path.join(group_dir, name), "rb") as f:
-            data = f.read()
+        # Guarded read: transient EIO/ESTALE on the shared spool retries.
+        data = rio.read_bytes(os.path.join(group_dir, name))
         current = None
         for line in data.split(b"\n"):
             if line.startswith(b"#B "):
@@ -708,8 +710,9 @@ def run_sharded_pipeline(
             comm.barrier()
             if comm.rank == 0:
                 os.makedirs(os.path.dirname(marker), exist_ok=True)
-                with open(marker, "w") as f:
-                    f.write("ok\n")
+                # Durable marker: a crash must not durably publish the
+                # marker without the spool bytes it vouches for.
+                rio.atomic_write(marker, "ok\n")
             comm.barrier()
 
         factory = pool_factory_for(len(my_units))
@@ -740,6 +743,11 @@ def run_sharded_pipeline(
             "units are journaled — re-run with resume=True/--resume to "
             "redo only the failures".format(
                 n_failed, failures or "none on this rank"))
+
+    # Integrity manifest (per-shard byte length + CRC32) for the loader's
+    # startup verification. Rank-strided like the census; no-op for txt
+    # output or under LDDL_TPU_MANIFEST=0.
+    build_manifest(out_dir, comm=comm, log=log)
 
     if comm.rank == 0:
         if global_shuffle:

@@ -1,0 +1,43 @@
+"""Fault-injection harness + resilient I/O + shard integrity.
+
+Three small layers the whole pipeline rides:
+
+- ``faults``    — env-armed fault injector (EIO/ESTALE/truncate/slow/kill)
+                  with fault points inside every guarded I/O primitive.
+- ``io``        — ``with_retries`` (backoff + jitter + deadline, transient
+                  OSErrors only) and the sanctioned atomic/durable write
+                  and resilient read primitives.
+- ``integrity`` — per-shard byte-length + CRC32 manifests written by the
+                  preprocessor and balancer, verified by the loader with a
+                  fail/quarantine policy. (Imported lazily by consumers —
+                  it depends on utils/ and parallel/, unlike faults/io
+                  which are stdlib-only.)
+"""
+
+from . import faults
+from .io import (
+    TRANSIENT_ERRNOS,
+    atomic_publish,
+    atomic_write,
+    is_transient,
+    open_append,
+    read_bytes,
+    read_table,
+    retry_policy,
+    with_retries,
+    write_table_atomic,
+)
+
+__all__ = [
+    "faults",
+    "TRANSIENT_ERRNOS",
+    "atomic_publish",
+    "atomic_write",
+    "is_transient",
+    "open_append",
+    "read_bytes",
+    "read_table",
+    "retry_policy",
+    "with_retries",
+    "write_table_atomic",
+]

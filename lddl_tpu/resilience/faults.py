@@ -1,0 +1,186 @@
+"""Process-wide fault injector for chaos tests and resilience validation.
+
+The pipeline's resilient I/O layer (resilience/io.py) calls
+``fault_point(op, path)`` at every guarded operation; when the injector is
+armed, matching calls raise transient ``OSError``s, truncate reads, sleep,
+or SIGKILL the calling process. Disarmed (the default), a fault point is a
+single dict lookup — effectively free on the hot path.
+
+Arming is ENV-VAR based (``LDDL_TPU_FAULTS``) so spawned pool/loader worker
+processes inherit the configuration automatically; ``arm()``/``disarm()``
+are conveniences that set/clear the env var and re-parse in-process.
+
+Spec grammar — comma-separated clauses of colon-separated fields::
+
+    <op>:<kind>[:p=<float>][:nth=<int>][:max=<int>][:seed=<int>]
+               [:path=<substr>][:delay=<float>][:flag=<file>]
+
+    op    site name: open | read | replace | worker (or * for any site)
+    kind  eio | estale | truncate | slow | kill
+    p     per-call injection probability (seeded per process)
+    nth   inject on exactly the Nth matching call of this process
+    max   cap on injections per process (default: 1 for nth, unlimited for p)
+    path  only calls whose path/tag contains this substring match
+    delay sleep seconds for kind=slow (default 0.2)
+    flag  cross-process once-latch: inject only while <file> does not
+          exist, and create it upon injection (survives respawned workers)
+
+Examples::
+
+    LDDL_TPU_FAULTS="read:eio:p=0.2:seed=7"        # flaky shard reads
+    LDDL_TPU_FAULTS="open:kill:nth=5:path=_shuffle:flag=/tmp/k1"
+    LDDL_TPU_FAULTS="worker:kill:nth=2:flag=/tmp/k2"  # loader worker death
+"""
+
+import errno
+import os
+import random
+import time
+
+ENV_VAR = "LDDL_TPU_FAULTS"
+
+_ERRNO_OF = {
+    "eio": errno.EIO,
+    "estale": getattr(errno, "ESTALE", errno.EIO),
+}
+
+# Parsed state: (raw_spec, [clause dicts]); counters are per-process and
+# per-clause. Re-parsed whenever the env var changes.
+_state = {"raw": None, "clauses": []}
+
+
+class FaultSpecError(ValueError):
+    pass
+
+
+def _parse_clause(text, index):
+    fields = text.strip().split(":")
+    if len(fields) < 2:
+        raise FaultSpecError(
+            "fault clause {!r} needs at least <op>:<kind>".format(text))
+    op, kind = fields[0].strip(), fields[1].strip()
+    if kind not in ("eio", "estale", "truncate", "slow", "kill"):
+        raise FaultSpecError("unknown fault kind {!r} in {!r}".format(
+            kind, text))
+    clause = {"op": op, "kind": kind, "p": None, "nth": None, "max": None,
+              "seed": 0, "path": None, "delay": 0.2, "flag": None,
+              "index": index}
+    for field in fields[2:]:
+        if "=" not in field:
+            raise FaultSpecError("malformed option {!r} in {!r}".format(
+                field, text))
+        key, value = field.split("=", 1)
+        if key == "p":
+            clause["p"] = float(value)
+        elif key == "nth":
+            clause["nth"] = int(value)
+        elif key == "max":
+            clause["max"] = int(value)
+        elif key == "seed":
+            clause["seed"] = int(value)
+        elif key == "path":
+            clause["path"] = value
+        elif key == "delay":
+            clause["delay"] = float(value)
+        elif key == "flag":
+            clause["flag"] = value
+        else:
+            raise FaultSpecError("unknown option {!r} in {!r}".format(
+                key, text))
+    if (clause["p"] is None) == (clause["nth"] is None):
+        raise FaultSpecError(
+            "fault clause {!r} needs exactly one of p= or nth=".format(text))
+    if clause["max"] is None and clause["nth"] is not None:
+        clause["max"] = 1
+    return clause
+
+
+def _parse(raw):
+    if not raw:
+        return []
+    return [_parse_clause(part, i)
+            for i, part in enumerate(raw.split(",")) if part.strip()]
+
+
+def _refresh():
+    raw = os.environ.get(ENV_VAR) or None
+    if raw != _state["raw"]:
+        _state["raw"] = raw
+        _state["clauses"] = _parse(raw)
+        for c in _state["clauses"]:
+            c["_calls"] = 0
+            c["_injected"] = 0
+            c["_rng"] = random.Random(c["seed"] * 1000003 + os.getpid())
+    return _state["clauses"]
+
+
+def arm(spec):
+    """Arm the injector for this process AND future child processes.
+    Re-arming (even with an identical spec) resets the call counters."""
+    os.environ[ENV_VAR] = spec
+    _state["raw"] = None  # force a re-parse so counters start fresh
+    _refresh()
+
+
+def disarm():
+    os.environ.pop(ENV_VAR, None)
+    _refresh()
+
+
+def armed():
+    return bool(_refresh())
+
+
+def _should_inject(clause, op, path):
+    if clause["op"] not in ("*", op):
+        return False
+    if clause["path"] is not None and clause["path"] not in (path or ""):
+        return False
+    if clause["flag"] is not None and os.path.exists(clause["flag"]):
+        return False
+    if clause["max"] is not None and clause["_injected"] >= clause["max"]:
+        return False
+    clause["_calls"] += 1
+    if clause["nth"] is not None:
+        return clause["_calls"] == clause["nth"]
+    return clause["_rng"].random() < clause["p"]
+
+
+def _latch(clause):
+    clause["_injected"] += 1
+    if clause["flag"] is not None:
+        try:
+            with open(clause["flag"], "x") as f:
+                f.write("injected\n")
+        except OSError:
+            pass
+
+
+def fault_point(op, path=None):
+    """Guarded-operation hook. Returns None (no fault) or the string
+    ``"truncate"`` (the caller must truncate the bytes it read). Raises
+    OSError / sleeps / SIGKILLs the process for the other kinds."""
+    clauses = _refresh()  # one env-dict lookup when disarmed
+    if not clauses:
+        return None
+    action = None
+    for clause in clauses:
+        if not _should_inject(clause, op, path):
+            continue
+        kind = clause["kind"]
+        if kind == "slow":
+            _latch(clause)
+            time.sleep(clause["delay"])
+        elif kind == "kill":
+            _latch(clause)
+            import signal
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif kind == "truncate":
+            _latch(clause)
+            action = "truncate"
+        else:
+            _latch(clause)
+            err = _ERRNO_OF[kind]
+            raise OSError(err, "injected fault [{}] at {}".format(
+                kind, op), path)
+    return action

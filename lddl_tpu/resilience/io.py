@@ -1,0 +1,252 @@
+"""Resilient I/O primitives: transient-error retries + durable atomic writes.
+
+TPU pods mount their shards over GCS-fuse/NFS (see preprocess/runner.py),
+where transient ``EIO``/``ESTALE``/timeout errors are a fact of life and a
+crash between ``write()`` and ``rename()`` can durably publish a torn file.
+This module is the single place the pipeline does either of:
+
+- retrying: ``with_retries`` wraps an operation in exponential backoff +
+  jitter + a total deadline, retrying ONLY transient OSErrors — a
+  ``FileNotFoundError`` or ``PermissionError`` fails immediately.
+- publishing: ``atomic_write``/``atomic_publish`` are the only sanctioned
+  ways to place a file into a shard directory (tmp in the same directory,
+  fsync the file, ``os.replace``, fsync the directory) — enforced by a
+  lint-style test over the whole package (tests/test_resilience.py).
+
+Every primitive calls ``faults.fault_point`` at its guarded operations, so
+the chaos harness can inject failures into real pipeline runs.
+
+Env knobs (all optional)::
+
+    LDDL_TPU_RETRY_ATTEMPTS      max attempts per operation (default 5)
+    LDDL_TPU_RETRY_DEADLINE_S    total time budget per operation (default 60)
+    LDDL_TPU_RETRY_BASE_DELAY_S  first backoff delay (default 0.05)
+    LDDL_TPU_RETRY_MAX_DELAY_S   backoff cap (default 2.0)
+"""
+
+import errno
+import os
+import random
+import time
+
+from . import faults
+
+# OSError errnos considered transient on shared storage: worth retrying.
+TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name) for name in (
+        "EIO", "ESTALE", "EAGAIN", "EINTR", "EBUSY", "ETIMEDOUT",
+        "ECONNRESET", "ECONNABORTED", "ENETRESET", "EHOSTUNREACH",
+        "ENOBUFS", "EREMOTEIO",
+    ) if hasattr(errno, name))
+
+
+def is_transient(exc):
+    """True for OSErrors that plausibly heal on retry (flaky NFS/GCS-fuse),
+    False for everything else (missing file, permissions, logic bugs)."""
+    return isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def retry_policy():
+    """The active retry knobs as a dict (also documented in README)."""
+    return {
+        "attempts": int(_env_float("LDDL_TPU_RETRY_ATTEMPTS", 5)),
+        "deadline_s": _env_float("LDDL_TPU_RETRY_DEADLINE_S", 60.0),
+        "base_delay_s": _env_float("LDDL_TPU_RETRY_BASE_DELAY_S", 0.05),
+        "max_delay_s": _env_float("LDDL_TPU_RETRY_MAX_DELAY_S", 2.0),
+    }
+
+
+_jitter_rng = random.Random()
+
+
+def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
+                 base_delay_s=None, max_delay_s=None, retryable=is_transient,
+                 log=None):
+    """Run ``fn()`` with exponential backoff + jitter + a total deadline.
+
+    Retries only exceptions for which ``retryable(exc)`` is true (by
+    default: transient OSErrors). The final failure re-raises the LAST
+    error with the attempt history attached to its message via
+    ``raise ... from`` chaining.
+    """
+    policy = retry_policy()
+    attempts = attempts if attempts is not None else policy["attempts"]
+    deadline_s = (deadline_s if deadline_s is not None
+                  else policy["deadline_s"])
+    base = (base_delay_s if base_delay_s is not None
+            else policy["base_delay_s"])
+    cap = max_delay_s if max_delay_s is not None else policy["max_delay_s"]
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - filtered by retryable()
+            if not retryable(e):
+                raise
+            elapsed = time.monotonic() - t0
+            if attempt >= attempts or elapsed >= deadline_s:
+                raise OSError(
+                    getattr(e, "errno", None) or errno.EIO,
+                    "{} failed after {} attempt(s) over {:.1f}s: {}".format(
+                        desc, attempt, elapsed, e),
+                    getattr(e, "filename", None)) from e
+            delay = min(cap, base * (2 ** (attempt - 1)))
+            delay *= _jitter_rng.uniform(0.5, 1.5)
+            delay = min(delay, max(0.0, deadline_s - elapsed))
+            if log is not None:
+                log("{}: transient error (attempt {}/{}), retrying in "
+                    "{:.2f}s: {}".format(desc, attempt, attempts, delay, e))
+            time.sleep(delay)
+
+
+def _fsync_dir(path):
+    """Flush a directory entry (the rename itself) to stable storage.
+    Best-effort: some filesystems (FAT, some FUSE mounts) refuse directory
+    fsync — a failure there must not undo a completed replace."""
+    try:
+        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                     os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_publish(tmp_path, path, fsync_file=True):
+    """Atomically move a fully-written temp file into place: fsync the
+    file's bytes, ``os.replace`` into the target name, fsync the directory
+    so the rename itself is durable. The ONLY sanctioned publish primitive
+    (with atomic_write) for files in shard directories."""
+    if fsync_file:
+        fd = os.open(tmp_path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    faults.fault_point("replace", path)
+    os.replace(tmp_path, path)
+    _fsync_dir(path)
+
+
+def atomic_write(path, data, retries=True):
+    """Durably and atomically write ``data`` (bytes or str) to ``path``.
+
+    A crash at any point leaves either the complete old file or the
+    complete new file — never a torn or empty one (tmp + fsync file +
+    ``os.replace`` + fsync dir). Transient storage errors are retried;
+    the temp file is always cleaned up on failure.
+    """
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    tmp = "{}.tmp.{}".format(path, os.getpid())
+
+    def _write():
+        faults.fault_point("open", path)
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            atomic_publish(tmp, path, fsync_file=False)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    if retries:
+        return with_retries(_write, desc="atomic_write {}".format(path))
+    return _write()
+
+
+def read_bytes(path, retries=True):
+    """Read a whole file with transient-error retries and fault injection
+    (``truncate`` faults chop the returned payload, simulating a torn
+    read on flaky storage)."""
+
+    def _read():
+        faults.fault_point("open", path)
+        with open(path, "rb") as f:
+            data = f.read()
+        action = faults.fault_point("read", path)
+        if action == "truncate":
+            data = data[:max(0, len(data) // 2 - 1)]
+        return data
+
+    if retries:
+        return with_retries(_read, desc="read {}".format(path))
+    return _read()
+
+
+def open_append(path, retries=True):
+    """Open a spool file for append, retrying transient open errors.
+    Only the OPEN retries: retrying a failed append could duplicate
+    bytes, so write errors propagate to the unit-level fault handling."""
+
+    def _open():
+        faults.fault_point("open", path)
+        return open(path, "ab")
+
+    if retries:
+        return with_retries(_open, desc="open append {}".format(path))
+    return _open()
+
+
+def read_table(path, retries=True):
+    """pyarrow ``read_table`` with transient-error retries + fault
+    injection — the sanctioned way every stage reads a parquet shard."""
+    import pyarrow.parquet as pq
+
+    def _read():
+        faults.fault_point("open", path)
+        if faults.fault_point("read", path) == "truncate":
+            # A torn parquet read cannot be emulated by chopping (pyarrow
+            # owns the file handle), so surface it the way a real torn
+            # read does: a permanent parse error, not a retried blip.
+            raise ValueError(
+                "injected truncated parquet read: {}".format(path))
+        return pq.read_table(path)
+
+    if retries:
+        return with_retries(_read, desc="read parquet {}".format(path))
+    return _read()
+
+
+def write_table_atomic(table, path, compression=None, retries=True):
+    """Write a pyarrow table via tmp + fsync + replace, so a crashed or
+    preempted writer can never publish a torn shard under its final name
+    (half-written ``part.*.parquet`` files were previously possible and
+    poisoned downstream stages)."""
+    import pyarrow.parquet as pq
+
+    tmp = "{}.tmp.{}".format(path, os.getpid())
+
+    def _write():
+        faults.fault_point("open", path)
+        try:
+            pq.write_table(table, tmp, compression=compression)
+            atomic_publish(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    if retries:
+        return with_retries(_write, desc="write parquet {}".format(path))
+    return _write()

@@ -18,6 +18,9 @@ import os
 import numpy as np
 import pyarrow.parquet as pq
 
+from ..resilience import faults
+from ..resilience.io import atomic_write, with_retries
+
 # Name of the per-directory sample-count cache written by the balancer and
 # consumed by the loader so startup does not need to touch every footer.
 # (ref: lddl/dask/load_balance.py:372-378, lddl/torch/datasets.py:166-187)
@@ -87,26 +90,66 @@ def get_file_paths_for_bin_id(file_paths, bin_id):
 
 
 def get_num_samples_of_parquet(path):
-    """Number of rows in a parquet shard, from metadata (no data read)."""
-    return pq.ParquetFile(path).metadata.num_rows
+    """Number of rows in a parquet shard, from metadata (no data read).
+
+    Transient storage errors retry (resilience.io); a corrupt/truncated
+    footer raises a ValueError that NAMES the shard instead of a bare
+    pyarrow error with no path in it."""
+
+    def _read():
+        faults.fault_point("open", path)
+        if faults.fault_point("read", path) == "truncate":
+            # Falls into the named-ValueError wrap below, like a real
+            # torn footer would.
+            raise RuntimeError("injected truncated footer read")
+        return pq.ParquetFile(path).metadata.num_rows
+
+    try:
+        return with_retries(_read, desc="parquet footer {}".format(path))
+    except OSError:
+        raise
+    except Exception as e:
+        raise ValueError(
+            "corrupt or truncated parquet shard {}: {}: {}".format(
+                path, type(e).__name__, e)) from e
 
 
 def read_num_samples_cache(dir_path):
-    """Load the .num_samples.json cache ({basename: count}) if present."""
+    """Load the .num_samples.json cache ({basename: count}) if present.
+    A corrupt/torn cache reads as absent (the caller recomputes) rather
+    than crashing startup."""
     cache_path = os.path.join(dir_path, NUM_SAMPLES_CACHE_NAME)
     if os.path.isfile(cache_path):
-        with open(cache_path, "r") as f:
-            return json.load(f)
+        try:
+            with open(cache_path, "r") as f:
+                cache = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return cache if isinstance(cache, dict) else None
     return None
 
 
+def num_samples_cache_is_stale(dir_path, cache):
+    """True when the cache's key set differs from the parquet shard
+    basenames actually on disk: a crash window or a partial re-balance can
+    durably publish a cache describing a different shard set, and trusting
+    it would silently mis-count an epoch. Stale caches are recomputed."""
+    if cache is None:
+        return True
+    try:
+        names = os.listdir(dir_path)
+    except OSError:
+        return True
+    on_disk = {n for n in names if _is_parquet_path(n)}
+    return set(cache) != on_disk
+
+
 def write_num_samples_cache(dir_path, counts):
-    """Store {basename: count} next to the shards. Atomic via rename."""
+    """Store {basename: count} next to the shards. Durable AND atomic
+    (resilience.io.atomic_write): the old tmp+rename path skipped fsync,
+    so a crash shortly after could durably publish an EMPTY cache file."""
     cache_path = os.path.join(dir_path, NUM_SAMPLES_CACHE_NAME)
-    tmp_path = cache_path + ".tmp.{}".format(os.getpid())
-    with open(tmp_path, "w") as f:
-        json.dump(counts, f)
-    os.replace(tmp_path, cache_path)
+    atomic_write(cache_path, json.dumps(counts))
 
 
 def serialize_np_array(a):
@@ -129,5 +172,27 @@ def serialize_np_array(a):
 
 def deserialize_np_array(b):
     if b[:1] == b"R":
-        return np.frombuffer(b, dtype=b[1:4].decode(), offset=4)
+        if len(b) < 4:
+            raise ValueError(
+                "truncated array payload: {} byte(s) with 'R' tag, need at "
+                "least 4 (1-byte tag + 3-byte dtype code)".format(len(b)))
+        try:
+            dtype = np.dtype(b[1:4].decode())
+        except (TypeError, UnicodeDecodeError) as e:
+            raise ValueError(
+                "corrupt array payload: 'R' tag with invalid dtype code "
+                "{!r} ({} bytes total)".format(bytes(b[1:4]), len(b))) from e
+        if (len(b) - 4) % dtype.itemsize:
+            raise ValueError(
+                "truncated array payload: {} data byte(s) after the "
+                "'R{}' tag is not a multiple of itemsize {}".format(
+                    len(b) - 4, dtype.str, dtype.itemsize))
+        return np.frombuffer(b, dtype=dtype, offset=4)
+    if not bytes(b[:6]) == b"\x93NUMPY":
+        # Empty or torn bytes would otherwise fall through to np.load and
+        # raise an opaque "Failed to interpret file as a pickle" error.
+        raise ValueError(
+            "array payload of {} byte(s) has neither the 'R' raw tag nor "
+            "the .npy magic; the shard bytes are likely truncated or "
+            "corrupt".format(len(b)))
     return np.load(io.BytesIO(b), allow_pickle=False)

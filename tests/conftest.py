@@ -23,7 +23,12 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # Older jax (< 0.5) has no jax_num_cpu_devices option; the XLA_FLAGS
+    # setting above already provides the 8 virtual CPU devices there.
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -139,7 +139,8 @@ def test_balance_drained_output_file_removed(tmp_path):
     dst = str(tmp_path / "dst")
     counts = balance_shards(src, dst, num_shards=4)
     on_disk = sorted(os.listdir(dst))
-    expected = sorted(list(counts.keys()) + [".num_samples.json"])
+    expected = sorted(list(counts.keys())
+                      + [".num_samples.json", ".manifest.json"])
     assert on_disk == expected
     for name, n in counts.items():
         assert get_num_samples_of_parquet(os.path.join(dst, name)) == n

@@ -1,0 +1,134 @@
+"""Chaos tests: REAL process death (SIGKILL) of the preprocess runner
+mid-scatter and mid-gather, then kill-and-resume byte-identity.
+
+These launch actual subprocesses and full pipeline runs, so they are
+marked ``slow`` (excluded from tier-1; run with ``-m slow``). The fast
+injector-based resilience suite lives in tests/test_resilience.py.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+pytestmark = [pytest.mark.slow, pytest.mark.fault]
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Driver executed as a subprocess so a SIGKILL takes out the WHOLE runner
+# (serial num_workers=1: the scatter/gather runs in the runner process
+# itself, exactly like a preempted pod host). argv: corpus vocab out resume
+_DRIVER = """
+import sys
+from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+from lddl_tpu.preprocess.runner import run_bert_preprocess
+
+corpus, vocab, out, resume = sys.argv[1:5]
+tok = get_tokenizer(vocab_file=vocab)
+cfg = BertPretrainConfig(max_seq_length=32, masking=True)
+run_bert_preprocess(
+    {"wikipedia": corpus}, out, tok, config=cfg, num_blocks=12,
+    sample_ratio=0.9, seed=4242, bin_size=8, global_shuffle=True,
+    resume=(resume == "resume"))
+"""
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("chaos")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    return str(td), corpus, vocab
+
+
+@pytest.fixture(scope="module")
+def reference_hashes(fixture_dirs, tmp_path_factory):
+    """Hashes of an UNINTERRUPTED run in this environment — the
+    byte-identity reference for the kill-and-resume tests. (Computed
+    fresh rather than from tests/golden_spool.json: the pinned goldens
+    additionally pin parquet codec bytes across library versions, which
+    is a different invariant than crash-recovery identity.)"""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path_factory.mktemp("reference") / "out")
+    proc = _run_driver(corpus, vocab, out, resume=False)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    hashes = gs.hash_outputs(out)
+    assert hashes  # produced shards
+    return hashes
+
+
+def _run_driver(corpus, vocab, out, resume, fault_spec=None, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if fault_spec:
+        env["LDDL_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("LDDL_TPU_FAULTS", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _DRIVER, corpus, vocab, out,
+         "resume" if resume else "fresh"],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=timeout)
+    return proc
+
+
+def test_sigkill_mid_scatter_then_resume_is_byte_identical(fixture_dirs,
+                                                           reference_hashes,
+                                                           tmp_path):
+    """SIGKILL the runner while it is appending to the shuffle spool
+    (open:kill on a _shuffle path). The rerun with --resume must wipe the
+    poisoned partial spool, redo the scatter, and produce output
+    byte-identical to an uninterrupted run."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _run_driver(corpus, vocab, out, resume=False,
+                       fault_spec="open:kill:nth=5:path=_shuffle")
+    assert proc.returncode == -9, proc.stdout + proc.stderr  # really SIGKILLed
+    # The kill landed mid-scatter: spool exists, completion marker doesn't.
+    assert os.path.isdir(os.path.join(out, "_shuffle"))
+    assert not os.path.exists(
+        os.path.join(out, "_shuffle", ".scatter_done"))
+
+    proc = _run_driver(corpus, vocab, out, resume=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert gs.hash_outputs(out) == reference_hashes
+
+
+def test_sigkill_mid_gather_then_resume_is_byte_identical(fixture_dirs,
+                                                          reference_hashes,
+                                                          tmp_path):
+    """SIGKILL the runner between gather units (replace:kill on a _done
+    ledger publish — after some units completed, others not). The resume
+    must redo ONLY the unfinished units, and the final shards must be
+    byte-identical to an uninterrupted run."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _run_driver(corpus, vocab, out, resume=False,
+                       fault_spec="replace:kill:nth=4:path=_done/group-")
+    assert proc.returncode == -9, proc.stdout + proc.stderr
+    # The kill landed mid-gather: scatter completed, some ledgers exist.
+    assert os.path.exists(os.path.join(out, "_shuffle", ".scatter_done"))
+    done = [n for n in os.listdir(os.path.join(out, "_done"))
+            if n.startswith("group-")]
+    assert 0 < len(done) < 12  # genuinely mid-gather
+
+    proc = _run_driver(corpus, vocab, out, resume=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert not os.path.isdir(os.path.join(out, "_done"))  # cleaned up
+    assert gs.hash_outputs(out) == reference_hashes
+
+
+def test_uninterrupted_runs_are_deterministic(fixture_dirs,
+                                              reference_hashes, tmp_path):
+    """Control: two independent fault-free runs are byte-identical, so
+    the kill tests above compare against a stable reference."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _run_driver(corpus, vocab, out, resume=False)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert gs.hash_outputs(out) == reference_hashes

@@ -1,0 +1,629 @@
+"""Fault-injection harness + resilient I/O layer + shard integrity.
+
+Fast injector-based tests (tier-1, marked ``fault``); the real
+process-death chaos tests live in tests/test_chaos.py (``slow``).
+"""
+
+import errno
+import json
+import os
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu.resilience import faults  # noqa: E402
+from lddl_tpu.resilience import integrity  # noqa: E402
+from lddl_tpu.resilience import io as rio  # noqa: E402
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("LDDL_TPU_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("LDDL_TPU_RETRY_MAX_DELAY_S", "0.01")
+
+
+# ---------------------------------------------------------------- faults
+
+
+def test_fault_spec_parsing_rejects_malformed():
+    with pytest.raises(faults.FaultSpecError):
+        faults._parse("read")  # no kind
+    with pytest.raises(faults.FaultSpecError):
+        faults._parse("read:frobnicate:p=0.5")  # unknown kind
+    with pytest.raises(faults.FaultSpecError):
+        faults._parse("read:eio")  # neither p nor nth
+    with pytest.raises(faults.FaultSpecError):
+        faults._parse("read:eio:p=0.5:nth=3")  # both
+    with pytest.raises(faults.FaultSpecError):
+        faults._parse("read:eio:p=0.5:wat=1")  # unknown option
+
+
+def test_nth_injects_exactly_once():
+    faults.arm("read:eio:nth=2")
+    assert faults.fault_point("read", "/x") is None
+    with pytest.raises(OSError) as ei:
+        faults.fault_point("read", "/x")
+    assert ei.value.errno == errno.EIO
+    for _ in range(5):  # nth defaults to max=1: spent
+        assert faults.fault_point("read", "/x") is None
+
+
+def test_probability_with_max_cap():
+    faults.arm("read:estale:p=1.0:max=2")
+    for _ in range(2):
+        with pytest.raises(OSError) as ei:
+            faults.fault_point("read", "/x")
+        assert ei.value.errno == getattr(errno, "ESTALE", errno.EIO)
+    assert faults.fault_point("read", "/x") is None
+
+
+def test_path_substring_and_op_filters():
+    faults.arm("open:eio:nth=1:path=shard-")
+    assert faults.fault_point("read", "/d/shard-1") is None  # wrong op
+    assert faults.fault_point("open", "/d/part-1") is None   # wrong path
+    with pytest.raises(OSError):
+        faults.fault_point("open", "/d/shard-1")
+
+
+def test_flag_file_is_a_cross_process_once_latch(tmp_path):
+    flag = str(tmp_path / "spent")
+    faults.arm("read:eio:nth=1:flag={}".format(flag))
+    with pytest.raises(OSError):
+        faults.fault_point("read", "/x")
+    assert os.path.exists(flag)  # latched for OTHER processes too
+    # Re-arming (fresh counters, like a respawned worker) must not re-fire.
+    faults.disarm()
+    faults.arm("read:eio:nth=1:flag={}".format(flag))
+    assert faults.fault_point("read", "/x") is None
+
+
+def test_disarmed_fault_point_is_noop():
+    assert faults.fault_point("read", "/x") is None
+    assert not faults.armed()
+
+
+# ------------------------------------------------------------ with_retries
+
+
+def test_with_retries_heals_transient_errors():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError(errno.EIO, "flaky")
+        return "ok"
+
+    assert rio.with_retries(flaky, desc="t") == "ok"
+    assert len(calls) == 3
+
+
+def test_with_retries_fails_immediately_on_permanent_errors():
+    calls = []
+
+    def missing():
+        calls.append(1)
+        raise FileNotFoundError(errno.ENOENT, "gone", "/x")
+
+    with pytest.raises(FileNotFoundError):
+        rio.with_retries(missing, desc="t")
+    assert len(calls) == 1  # ENOENT is not transient: no retry
+
+
+def test_with_retries_exhaustion_names_operation_and_attempts():
+    def always():
+        raise OSError(errno.EIO, "still broken")
+
+    with pytest.raises(OSError, match="frob failed after 3 attempt"):
+        rio.with_retries(always, desc="frob", attempts=3)
+
+
+def test_is_transient_classification():
+    assert rio.is_transient(OSError(errno.EIO, "x"))
+    assert rio.is_transient(OSError(getattr(errno, "ESTALE", errno.EIO), "x"))
+    assert not rio.is_transient(OSError(errno.ENOENT, "x"))
+    assert not rio.is_transient(ValueError("x"))
+
+
+# ------------------------------------------------------------ atomic I/O
+
+
+def test_atomic_write_roundtrip_and_no_tmp_leftovers(tmp_path):
+    path = str(tmp_path / "cache.json")
+    rio.atomic_write(path, '{"a": 1}')
+    assert json.load(open(path)) == {"a": 1}
+    rio.atomic_write(path, b'{"a": 2}')
+    assert json.load(open(path)) == {"a": 2}
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp." in n] == []
+
+
+def test_atomic_write_failure_preserves_old_content(tmp_path):
+    path = str(tmp_path / "cache.json")
+    rio.atomic_write(path, "old")
+    faults.arm("replace:eio:p=1.0")
+    with pytest.raises(OSError):
+        rio.atomic_write(path, "new", retries=False)
+    faults.disarm()
+    assert open(path).read() == "old"  # complete old file, never torn
+    assert [n for n in os.listdir(str(tmp_path)) if ".tmp." in n] == []
+
+
+def test_atomic_write_retries_through_transient_replace_errors(tmp_path):
+    path = str(tmp_path / "cache.json")
+    faults.arm("replace:eio:nth=1")
+    rio.atomic_write(path, "content")
+    assert open(path).read() == "content"
+
+
+def test_read_bytes_retries_and_truncation_injection(tmp_path):
+    path = str(tmp_path / "payload.bin")
+    rio.atomic_write(path, b"0123456789")
+    faults.arm("open:eio:nth=1")
+    assert rio.read_bytes(path) == b"0123456789"  # healed by retry
+    faults.arm("read:truncate:nth=1")
+    assert len(rio.read_bytes(path, retries=False)) < 10
+
+
+def test_read_table_retries_transient_open_errors(tmp_path):
+    path = str(tmp_path / "t.parquet")
+    rio.write_table_atomic(pa.table({"x": list(range(7))}), path)
+    faults.arm("open:eio:nth=1")
+    assert rio.read_table(path).num_rows == 7
+
+
+# ------------------------------------------------------- fs.py satellites
+
+
+def test_get_num_samples_names_the_corrupt_shard(tmp_path):
+    from lddl_tpu.utils.fs import get_num_samples_of_parquet
+    bad = str(tmp_path / "part.0.parquet")
+    with open(bad, "wb") as f:
+        f.write(b"this is not parquet")
+    with pytest.raises(ValueError, match="part.0.parquet"):
+        get_num_samples_of_parquet(bad)
+
+
+def test_get_num_samples_retries_transient_errors(tmp_path):
+    from lddl_tpu.utils.fs import get_num_samples_of_parquet
+    path = str(tmp_path / "part.0.parquet")
+    rio.write_table_atomic(pa.table({"x": [1, 2, 3]}), path)
+    faults.arm("open:eio:nth=1")
+    assert get_num_samples_of_parquet(path) == 3
+
+
+def test_corrupt_num_samples_cache_reads_as_absent(tmp_path):
+    from lddl_tpu.utils.fs import (NUM_SAMPLES_CACHE_NAME,
+                                   read_num_samples_cache)
+    d = str(tmp_path)
+    with open(os.path.join(d, NUM_SAMPLES_CACHE_NAME), "w") as f:
+        f.write('{"torn": ')  # torn write from a crashed publisher
+    assert read_num_samples_cache(d) is None
+
+
+def test_num_samples_cache_staleness_on_key_mismatch(tmp_path):
+    from lddl_tpu.utils.fs import num_samples_cache_is_stale
+    d = str(tmp_path)
+    rio.write_table_atomic(pa.table({"x": [1]}),
+                           os.path.join(d, "shard-0.parquet"))
+    rio.write_table_atomic(pa.table({"x": [1]}),
+                           os.path.join(d, "shard-1.parquet"))
+    good = {"shard-0.parquet": 1, "shard-1.parquet": 1}
+    assert not num_samples_cache_is_stale(d, good)
+    assert num_samples_cache_is_stale(d, {"shard-0.parquet": 1})  # missing
+    assert num_samples_cache_is_stale(d, dict(good, ghost=3))     # extra
+    assert num_samples_cache_is_stale(d, None)
+
+
+def test_dataset_recomputes_counts_from_stale_cache(tmp_path):
+    """A cache whose keys mismatch the shards on disk must be ignored
+    (recompute from footers), not trusted."""
+    from lddl_tpu.loader.datasets import ParquetDataset
+    from lddl_tpu.utils.fs import write_num_samples_cache
+    d = str(tmp_path)
+    paths = []
+    for i in range(2):
+        p = os.path.join(d, "shard-{}.parquet".format(i))
+        rio.write_table_atomic(pa.table({"x": list(range(5))}), p)
+        paths.append(p)
+    # Cache describes a DIFFERENT shard set with absurd counts.
+    write_num_samples_cache(d, {"shard-0.parquet": 999, "ghost.parquet": 7})
+
+    def decode(b):
+        yield from b.to_pydict()["x"]
+
+    ds = ParquetDataset(paths, decode_record_batch=decode)
+    assert ds.num_samples_per_file == 5  # recomputed, not 999
+
+
+# ------------------------------------------------------------- integrity
+
+
+def _make_shards(d, n_shards=4, rows=6):
+    paths = []
+    for i in range(n_shards):
+        p = os.path.join(str(d), "shard-{}.parquet".format(i))
+        rio.write_table_atomic(
+            pa.table({"x": [i * 100 + r for r in range(rows)]}), p)
+        paths.append(p)
+    return paths
+
+
+def test_manifest_roundtrip_and_verify_ok(tmp_path):
+    paths = _make_shards(tmp_path)
+    manifest = integrity.build_manifest(str(tmp_path))
+    assert set(manifest) == {os.path.basename(p) for p in paths}
+    on_disk = integrity.read_manifest(str(tmp_path))
+    assert on_disk == manifest
+    good, excluded = integrity.verify_shards(paths)
+    assert good == paths and excluded == []
+
+
+def test_manifest_build_is_spmd_consistent(tmp_path):
+    """Rank-strided checksumming must produce the identical manifest on
+    every rank (each entry computed by exactly one rank + sum-allreduce)."""
+    from lddl_tpu.parallel.distributed import ThreadGroupCommunicator
+    _make_shards(tmp_path, n_shards=5)
+    results = ThreadGroupCommunicator.spawn(
+        3, lambda comm: integrity.build_manifest(str(tmp_path), comm=comm))
+    assert results[0] == results[1] == results[2]
+    assert integrity.read_manifest(str(tmp_path)) == results[0]
+
+
+def test_truncated_shard_fails_startup_by_name(tmp_path):
+    paths = _make_shards(tmp_path)
+    integrity.build_manifest(str(tmp_path))
+    with open(paths[2], "r+b") as f:
+        f.truncate(os.path.getsize(paths[2]) // 2)
+    with pytest.raises(integrity.ShardIntegrityError, match="shard-2"):
+        integrity.verify_shards(paths)
+
+
+def test_truncated_shard_quarantine_excludes_exactly_it(tmp_path):
+    paths = _make_shards(tmp_path)
+    integrity.build_manifest(str(tmp_path))
+    with open(paths[1], "r+b") as f:
+        f.truncate(3)
+    with pytest.warns(UserWarning, match="QUARANTINED"):
+        good, excluded = integrity.verify_shards(paths,
+                                                 on_corrupt="quarantine")
+    assert good == [paths[0], paths[2], paths[3]]
+    assert [p for p, _ in excluded] == [paths[1]]
+    assert "size mismatch" in excluded[0][1]
+
+
+def test_same_size_corruption_caught_by_crc(tmp_path):
+    paths = _make_shards(tmp_path)
+    integrity.build_manifest(str(tmp_path))
+    size = os.path.getsize(paths[0])
+    with open(paths[0], "r+b") as f:
+        f.seek(size // 2)
+        f.write(b"\xff\xfe")
+    # Size check alone cannot see it...
+    good, _ = integrity.verify_shards(paths, on_corrupt="quarantine",
+                                      check_crc=False)
+    assert good == paths
+    # ...full CRC verification does.
+    with pytest.warns(UserWarning, match="crc32 mismatch"):
+        good, excluded = integrity.verify_shards(
+            paths, on_corrupt="quarantine", check_crc=True)
+    assert [p for p, _ in excluded] == [paths[0]]
+
+
+def test_verify_retries_transient_stat_errors(tmp_path):
+    """A transient EIO during the startup stat of a HEALTHY shard must
+    not read as corruption (no spurious quarantine/refusal)."""
+    paths = _make_shards(tmp_path)
+    integrity.build_manifest(str(tmp_path))
+    faults.arm("open:eio:nth=1")
+    good, excluded = integrity.verify_shards(paths)
+    assert good == paths and excluded == []
+
+
+def test_verify_is_rank_strided_and_spmd_consistent(tmp_path):
+    """Multi-rank verify stripes the checks and allreduces the verdicts:
+    every rank must exclude the IDENTICAL shard set (a rank-divergent
+    list would desync the SPMD epoch)."""
+    from lddl_tpu.parallel.distributed import ThreadGroupCommunicator
+    paths = _make_shards(tmp_path, n_shards=5)
+    integrity.build_manifest(str(tmp_path))
+    with open(paths[3], "r+b") as f:
+        f.truncate(4)
+    import warnings as _w
+
+    def check(comm):
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            good, excluded = integrity.verify_shards(
+                paths, on_corrupt="quarantine", comm=comm)
+        return good, [p for p, _ in excluded]
+
+    results = ThreadGroupCommunicator.spawn(3, check)
+    assert results[0] == results[1] == results[2]
+    assert results[0][1] == [paths[3]]
+
+
+def test_truncate_fault_surfaces_at_parquet_read(tmp_path):
+    """A read:truncate fault must not silently no-op at parquet read
+    sites: it surfaces as a permanent parse-style error (false-green
+    chaos runs are worse than no chaos runs)."""
+    path = str(tmp_path / "t.parquet")
+    rio.write_table_atomic(pa.table({"x": [1, 2]}), path)
+    faults.arm("read:truncate:nth=1")
+    with pytest.raises(ValueError, match="truncated parquet read"):
+        rio.read_table(path, retries=False)
+    from lddl_tpu.utils.fs import get_num_samples_of_parquet
+    faults.arm("read:truncate:nth=1")
+    with pytest.raises(ValueError, match="corrupt or truncated"):
+        get_num_samples_of_parquet(path)
+
+
+def test_whole_bin_quarantined_names_the_quarantine(bert_shard_dir,
+                                                    tmp_path):
+    """Quarantining every shard of a MIDDLE bin leaves a bin-id gap; the
+    contiguity error must point at the quarantine, not the preprocessor."""
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    from lddl_tpu.preprocess.binning import make_schema
+    _, vocab = bert_shard_dir
+    d = str(tmp_path / "binned")
+    os.makedirs(d)
+    schema = make_schema(masking=False, binned=True)
+    for b in range(3):
+        for i in range(2):
+            rows = {
+                "A": ["alpha beta"] * 3,
+                "B": ["gamma delta"] * 3,
+                "is_random_next": [False, True, False],
+                "num_tokens": [7, 7, 7],
+                "bin_id": [b] * 3,
+            }
+            rio.write_table_atomic(
+                pa.table(rows, schema=schema),
+                os.path.join(d, "shard-{}.parquet_{}".format(i, b)))
+    integrity.build_manifest(d)
+    for i in range(2):  # corrupt ALL of bin 1
+        victim = os.path.join(d, "shard-{}.parquet_1".format(i))
+        with open(victim, "r+b") as f:
+            f.truncate(4)
+    with pytest.warns(UserWarning):
+        with pytest.raises(ValueError,
+                           match="quarantined at startup"):
+            get_bert_pretrain_data_loader(d, vocab_file=vocab, batch_size=2,
+                                          on_corrupt="quarantine",
+                                          return_raw_samples=True)
+
+
+def test_size_mode_manifest_has_no_crc_and_still_verifies(tmp_path,
+                                                          monkeypatch):
+    """LDDL_TPU_MANIFEST=size records byte lengths only (zero extra read
+    passes); verification still catches truncation by size and skips the
+    crc re-hash gracefully even when asked for it."""
+    monkeypatch.setenv("LDDL_TPU_MANIFEST", "size")
+    paths = _make_shards(tmp_path)
+    manifest = integrity.build_manifest(str(tmp_path))
+    assert all("crc32" not in e for e in manifest.values())
+    good, excluded = integrity.verify_shards(paths, check_crc=True)
+    assert good == paths
+    with open(paths[0], "r+b") as f:
+        f.truncate(3)
+    with pytest.raises(integrity.ShardIntegrityError, match="shard-0"):
+        integrity.verify_shards(paths)
+
+
+def test_missing_manifest_trusts_shards(tmp_path):
+    paths = _make_shards(tmp_path)
+    good, excluded = integrity.verify_shards(paths)
+    assert good == paths and excluded == []
+
+
+def test_verify_rejects_unknown_policy(tmp_path):
+    with pytest.raises(ValueError, match="on_corrupt"):
+        integrity.verify_shards([], on_corrupt="shrug")
+
+
+# ------------------------------------------- loader startup integration
+
+
+@pytest.fixture(scope="module")
+def bert_shard_dir(tmp_path_factory):
+    """Four tiny balanced BERT-schema shards + cache + manifest."""
+    d = tmp_path_factory.mktemp("bert_shards")
+    from lddl_tpu.preprocess.binning import make_schema
+    from lddl_tpu.utils.fs import write_num_samples_cache
+    schema = make_schema(masking=False, binned=False)
+    counts = {}
+    for i in range(4):
+        rows = {
+            "A": ["alpha beta"] * 3,
+            "B": ["gamma delta"] * 3,
+            "is_random_next": [False, True, False],
+            "num_tokens": [7, 7, 7],
+        }
+        name = "shard-{}.parquet".format(i)
+        rio.write_table_atomic(pa.table(rows, schema=schema),
+                               os.path.join(str(d), name))
+        counts[name] = 3
+    write_num_samples_cache(str(d), counts)
+    vocab = gs.build_vocab(str(d))
+    integrity.build_manifest(str(d))
+    return str(d), vocab
+
+
+def test_loader_quarantines_truncated_shard_at_startup(bert_shard_dir,
+                                                       tmp_path):
+    import shutil
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    src, vocab = bert_shard_dir
+    d = str(tmp_path / "shards")
+    shutil.copytree(src, d)
+    victim = os.path.join(d, "shard-2.parquet")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 3)
+
+    # Default policy refuses to start, naming the shard.
+    with pytest.raises(integrity.ShardIntegrityError, match="shard-2"):
+        get_bert_pretrain_data_loader(d, vocab_file=vocab, batch_size=2)
+
+    # Quarantine starts on the 3 survivors and logs the exclusion.
+    with pytest.warns(UserWarning, match="shard-2"):
+        loader = get_bert_pretrain_data_loader(
+            d, vocab_file=vocab, batch_size=2, on_corrupt="quarantine",
+            return_raw_samples=True)
+    assert len(loader.dataset) == 9  # 3 shards x 3 samples; counts explicit
+    assert sum(len(b) for b in loader) == 9  # and it actually iterates
+
+
+def test_loader_env_var_policy_default(bert_shard_dir, tmp_path, monkeypatch):
+    import shutil
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    src, vocab = bert_shard_dir
+    d = str(tmp_path / "shards")
+    shutil.copytree(src, d)
+    victim = os.path.join(d, "shard-0.parquet")
+    with open(victim, "r+b") as f:
+        f.truncate(5)
+    monkeypatch.setenv("LDDL_TPU_ON_CORRUPT", "quarantine")
+    with pytest.warns(UserWarning, match="shard-0"):
+        loader = get_bert_pretrain_data_loader(
+            d, vocab_file=vocab, batch_size=2, return_raw_samples=True)
+    assert len(loader.dataset) == 9
+
+
+# ------------------------------------- end-to-end fault-masking identity
+
+
+def test_pipeline_identical_under_injected_transient_eio(tmp_path,
+                                                         monkeypatch):
+    """The acceptance bar: with transient EIO injected on shard reads at
+    p=0.2, a full mini preprocess -> balance -> load run produces batch
+    streams identical to the fault-free run (every fault healed by
+    retries, nothing silently skipped)."""
+    from lddl_tpu.balance import balance_shards
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    monkeypatch.setenv("LDDL_TPU_RETRY_ATTEMPTS", "10")
+
+    corpus = gs.build_corpus(str(tmp_path / "corpus"))
+    vocab = gs.build_vocab(str(tmp_path))
+
+    def run(tag, arm_spec):
+        pre = str(tmp_path / ("pre_" + tag))
+        shards = str(tmp_path / ("shards_" + tag))
+        if arm_spec:
+            faults.arm(arm_spec)
+        try:
+            gs.run_case(corpus, vocab, pre, binned=False)
+            balance_shards(pre, shards, num_shards=4)
+            loader = get_bert_pretrain_data_loader(
+                shards, vocab_file=vocab, batch_size=4,
+                return_raw_samples=True)
+            return [s for batch in loader for s in batch]
+        finally:
+            faults.disarm()
+
+    clean = run("clean", None)
+    faulty = run("faulty", "read:eio:p=0.2:seed=11,open:eio:p=0.1:seed=12")
+    assert len(clean) > 0
+    assert faulty == clean
+
+
+# ------------------------------------------- loader worker supervision
+
+
+@pytest.fixture(autouse=True)
+def _fast_death_detection(monkeypatch):
+    from lddl_tpu.loader.dataloader import DataLoader
+    monkeypatch.setattr(DataLoader, "_POLL_TIMEOUT_S", 0.5)
+
+
+def _process_loader(shard_dir, vocab, num_workers=2):
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    return get_bert_pretrain_data_loader(
+        shard_dir, vocab_file=vocab, batch_size=2, num_workers=num_workers,
+        return_raw_samples=True, worker_mode="process",
+        shuffle_buffer_size=8, shuffle_buffer_warmup_factor=2)
+
+
+def test_killed_worker_restarts_once_with_identical_batches(bert_shard_dir,
+                                                            tmp_path):
+    """SIGKILL a persistent process worker mid-epoch: the supervisor must
+    restart it ONCE and replay its pure (seed, epoch, dp, worker) stream,
+    leaving the consumer-visible batch sequence unchanged."""
+    src, vocab = bert_shard_dir
+
+    clean_loader = _process_loader(src, vocab)
+    try:
+        clean = list(clean_loader)
+    finally:
+        clean_loader.shutdown_workers()
+    assert len(clean) > 2
+
+    flag = str(tmp_path / "killed.flag")
+    faults.arm("worker:kill:nth=2:path=w0:flag={}".format(flag))
+    loader = _process_loader(src, vocab)
+    try:
+        with pytest.warns(UserWarning, match="worker 0 died.*restarting"):
+            faulty = list(loader)
+    finally:
+        faults.disarm()
+        loader.shutdown_workers()
+    assert os.path.exists(flag)  # the kill really happened
+    assert faulty == clean
+
+
+def test_worker_dying_twice_fails_fast_with_named_error(bert_shard_dir,
+                                                        tmp_path):
+    """No flag latch: the restarted worker hits the same kill again. The
+    second death must raise a named-worker error, not loop forever."""
+    src, vocab = bert_shard_dir
+    faults.arm("worker:kill:nth=2:path=w0")
+    loader = _process_loader(src, vocab)
+    try:
+        with pytest.warns(UserWarning, match="worker 0 died"):
+            with pytest.raises(RuntimeError,
+                               match="worker 0 died again after a restart"):
+                list(loader)
+    finally:
+        faults.disarm()
+        loader.shutdown_workers()
+
+
+# ---------------------------------------------------- lint: atomic writes
+
+
+def test_no_raw_os_replace_outside_resilience_io():
+    """Every publish into a shard directory must go through
+    resilience.io.atomic_write/atomic_publish (fsync + replace + dir
+    fsync). A raw os.replace elsewhere re-opens the torn-publish window
+    this PR closed."""
+    import lddl_tpu
+    pkg_root = os.path.dirname(lddl_tpu.__file__)
+    allowed = {os.path.join("resilience", "io.py")}
+    offenders = []
+    for dirpath, _, filenames in os.walk(pkg_root):
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, pkg_root)
+            if rel in allowed:
+                continue
+            with open(path, encoding="utf-8") as f:
+                src = f.read()
+            if "os.replace(" in src:
+                offenders.append(rel)
+    assert offenders == [], (
+        "raw os.replace( outside resilience/io.py in: {} -- route these "
+        "through resilience.io.atomic_write/atomic_publish".format(offenders))
