@@ -101,6 +101,13 @@ def attach_args():
                         "corrupt shard(s); quarantine = exclude them "
                         "loudly and run on the survivors (default: "
                         "$LDDL_TPU_ON_CORRUPT, then fail)")
+    p.add_argument("--metrics-dir", default=None,
+                   help="arm lddl_tpu.observability and write metric "
+                        "snapshots (.jsonl), a Prometheus textfile, "
+                        "Chrome-trace JSONL (Perfetto) and an end-of-run "
+                        "summary-*.json here; also prints the telemetry "
+                        "report (padding efficiency, resilience activity) "
+                        "after the run")
     return p
 
 
@@ -126,9 +133,46 @@ def _debug_print(loader, tokenizer):
             return
 
 
+def _telemetry_report(obs):
+    """End-of-run telemetry: headline numbers on stdout + summary json,
+    prom textfile and trace flush in the metrics dir."""
+    s = obs.summary()
+    print("telemetry: padding efficiency {} ({} real tokens / {} slots)"
+          .format("{:.4f}".format(s["padding_efficiency"])
+                  if s["padding_efficiency"] is not None else "n/a",
+                  s["real_tokens"], s["padded_slots"]))
+    print("telemetry: resilience activity: {} retries, {} faults "
+          "injected, {} worker restarts, {} quarantined shards".format(
+              s["retries"], s["faults_injected"], s["worker_restarts"],
+              s["quarantined_shards"]))
+    reg = obs.registry()
+    hist = reg.get("loader_batch_latency_seconds")
+    if hist is not None:
+        st = hist.stats()
+        if st:
+            print("telemetry: batch latency mean {:.2f} ms over {} "
+                  "batches (max {:.2f} ms)".format(
+                      1e3 * st["sum"] / max(st["count"], 1), st["count"],
+                      1e3 * st["max"]))
+    bins = reg.get("loader_bin_choice_total")
+    if bins is not None:
+        print("telemetry: bin choices {}".format(bins.snapshot()["values"]))
+    obs.export_prom()
+    obs.export_jsonl()
+    path = obs.write_summary()
+    if path:
+        print("telemetry: wrote {}".format(path))
+
+
 def main():
     args = attach_args().parse_args()
     from lddl_tpu.loader import get_bert_pretrain_data_loader, to_device_batch
+    # The observability hooks are inert no-ops unless armed, so no
+    # conditional plumbing: configure() is the only gated call.
+    from lddl_tpu import observability as obs
+
+    if args.metrics_dir:
+        obs.configure(dir=args.metrics_dir, periodic=True)
 
     if args.family == "bart":
         from lddl_tpu.loader.bart import get_bart_pretrain_data_loader
@@ -242,42 +286,44 @@ def main():
     total_samples = 0
     total_wall = 0.0
 
-    for epoch in range(args.start_epoch, args.start_epoch + args.epochs):
-        epoch_t0 = time.perf_counter()
-        epoch_samples = 0
-        t0 = time.perf_counter()
-        for i, batch in enumerate(loader):
-            n, L = batch["input_ids"].shape
-            # Shape contracts (ref torch_train.py:171-175).
-            assert batch["attention_mask"].shape == (n, L)
-            assert batch["labels"].shape == (n, L)
-            if args.family == "bart":
-                assert batch["decoder_input_ids"].shape == (n, L)
-            else:
-                assert batch["token_type_ids"].shape == (n, L)
-                assert batch["next_sentence_labels"].shape == (n,)
-            lens = batch["attention_mask"].sum(axis=1)
-            seq_len_hist.update(L, n)
-            pad_hist.update(L, int((L - lens).sum()))
-            all_min_lens.append(int(lens.min()))
-            all_max_lens.append(int(lens.max()))
-            all_batch_lens.append(L)
-            if step is not None:
-                ts = time.perf_counter()
-                metrics = step(batch)
-                float(metrics["loss"])  # sync
-                step_time.update(time.perf_counter() - ts)
-            dt = time.perf_counter() - t0
-            batch_time.update(dt)
-            throughput.update(n / dt)
-            epoch_samples += n
-            if (i + 1) % args.log_freq == 0:
-                print("epoch {} it {}: {:.1f} samples/s, {:.2f} ms/batch"
-                      .format(epoch, i + 1, throughput.avg,
-                              batch_time.avg * 1e3))
+    with obs.span("mock_train.run", epochs=args.epochs,
+                  batch_size=args.batch_size):
+        for epoch in range(args.start_epoch, args.start_epoch + args.epochs):
+            epoch_t0 = time.perf_counter()
+            epoch_samples = 0
             t0 = time.perf_counter()
-        total_samples += epoch_samples
-        total_wall += time.perf_counter() - epoch_t0
+            for i, batch in enumerate(loader):
+                n, L = batch["input_ids"].shape
+                # Shape contracts (ref torch_train.py:171-175).
+                assert batch["attention_mask"].shape == (n, L)
+                assert batch["labels"].shape == (n, L)
+                if args.family == "bart":
+                    assert batch["decoder_input_ids"].shape == (n, L)
+                else:
+                    assert batch["token_type_ids"].shape == (n, L)
+                    assert batch["next_sentence_labels"].shape == (n,)
+                lens = batch["attention_mask"].sum(axis=1)
+                seq_len_hist.update(L, n)
+                pad_hist.update(L, int((L - lens).sum()))
+                all_min_lens.append(int(lens.min()))
+                all_max_lens.append(int(lens.max()))
+                all_batch_lens.append(L)
+                if step is not None:
+                    ts = time.perf_counter()
+                    metrics = step(batch)
+                    float(metrics["loss"])  # sync
+                    step_time.update(time.perf_counter() - ts)
+                dt = time.perf_counter() - t0
+                batch_time.update(dt)
+                throughput.update(n / dt)
+                epoch_samples += n
+                if (i + 1) % args.log_freq == 0:
+                    print("epoch {} it {}: {:.1f} samples/s, {:.2f} ms/batch"
+                          .format(epoch, i + 1, throughput.avg,
+                                  batch_time.avg * 1e3))
+                t0 = time.perf_counter()
+            total_samples += epoch_samples
+            total_wall += time.perf_counter() - epoch_t0
 
     total_tokens = sum(k * v for k, v in seq_len_hist.counts.items())
     total_pad = sum(pad_hist.counts.values())
@@ -303,6 +349,13 @@ def main():
             batch_lens=np.asarray(all_batch_lens),
         )
         print("wrote {}/lens_{}.npz".format(args.seq_len_dir, args.dp_rank))
+    if args.metrics_dir:
+        # Observability cross-check: the loader's own sustained rate goes
+        # into the summary so the instrumented numbers sit next to the
+        # meter the benchmark has always printed.
+        obs.set_gauge("mock_train_sustained_samples_per_second",
+                      total_samples / max(total_wall, 1e-9))
+        _telemetry_report(obs)
 
 
 if __name__ == "__main__":

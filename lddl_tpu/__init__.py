@@ -24,6 +24,10 @@ Layer map (mirrors reference lddl/ layering, see SURVEY.md):
     models/      -> reference BERT/BART models + train steps (new; the
                     mock-training harness the reference keeps in benchmarks/)
     parallel/    -> mesh + multihost coordination (ref: MPI/NCCL usage)
+    resilience/  -> retries, atomic publish, integrity, fault injection
+    observability/ -> metrics registry + span tracing + exporters (inert
+                    by contract; armed via LDDL_TPU_METRICS_DIR — see
+                    README "Observability" for the stable metric names)
 """
 
 __version__ = "0.1.0"

@@ -36,6 +36,7 @@ import os
 
 import pyarrow as pa
 
+from .. import observability as obs
 from ..preprocess.binning import DEFAULT_PARQUET_COMPRESSION
 
 from ..parallel.distributed import LocalCommunicator
@@ -103,6 +104,7 @@ class _Shard:
             assert table.num_rows == num_samples
             write_table_atomic(table, path,
                                compression=DEFAULT_PARQUET_COMPRESSION)
+            _count_bytes_rewritten(path)
 
     def _load(self, num_samples, with_table):
         """Remove rows, consuming input files from the end first, then
@@ -142,6 +144,11 @@ class _Shard:
         destination (the dominant I/O cost when one giant file feeds many
         shards)."""
         total = sum(n for _, n in assignments)
+        if i_am_owner:
+            # Owner-side count: every rank mirrors the plan metadata, but
+            # only the owner moves rows — counting there keeps the counter
+            # exact per process in multi-rank (thread-comm) layouts too.
+            obs.inc("balance_samples_moved_total", total)
         table = self._load(total, with_table=i_am_owner)
         offset = 0
         for other, n in assignments:
@@ -167,9 +174,21 @@ class _Shard:
             assert table.num_rows == n
             write_table_atomic(table, self.out_path,
                                compression=DEFAULT_PARQUET_COMPRESSION)
+            _count_bytes_rewritten(self.out_path)
             for f in parts:
                 os.remove(f.path)
         self.final_file = File(self.out_path, n)
+
+
+def _count_bytes_rewritten(path):
+    """Bytes this rank physically wrote while balancing (custody parts +
+    final merges) — the I/O cost the ``stats`` row counts only imply."""
+    if not obs.enabled():
+        return
+    try:
+        obs.inc("balance_bytes_rewritten_total", os.stat(path).st_size)
+    except OSError:
+        pass
 
 
 def _census(file_paths, comm):
@@ -274,6 +293,13 @@ def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None,
     log = log or (lambda msg: None)
     if num_shards < 1:
         raise ValueError("num_shards must be >= 1")
+    # Top-level stage span (lint-enforced: tests/test_observability.py).
+    with obs.span("balance.run", rank=comm.rank, num_shards=num_shards):
+        return _balance_shards_body(in_dir, out_dir, num_shards, comm, log,
+                                    stats)
+
+
+def _balance_shards_body(in_dir, out_dir, num_shards, comm, log, stats):
     if os.path.isdir(out_dir):
         stale = [n for n in os.listdir(out_dir) if ".parquet" in n]
         if stale:
@@ -297,9 +323,10 @@ def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None,
                     len(unbinned), os.path.basename(unbinned[0])))
         for b in bin_ids:
             bin_paths = get_file_paths_for_bin_id(file_paths, b)
-            counts.update(
-                _balance_one_set(bin_paths, out_dir, num_shards, comm,
-                                 postfix="_{}".format(b), stats=stats))
+            with obs.span("balance.bin", bin=b, files=len(bin_paths)):
+                counts.update(
+                    _balance_one_set(bin_paths, out_dir, num_shards, comm,
+                                     postfix="_{}".format(b), stats=stats))
             log("balanced bin {}: {} files -> {} shards".format(
                 b, len(bin_paths), num_shards))
     else:

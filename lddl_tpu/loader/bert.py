@@ -360,6 +360,9 @@ class PackedBertLoader:
             inflight.append(pool.submit(self._collate, rows_local, samples,
                                         g=g))
 
+        from .. import observability as obs
+        obs_on = obs.enabled()
+
         def drain(block):
             while inflight and (block
                                 or len(inflight) > self._COLLATE_THREADS):
@@ -367,6 +370,16 @@ class PackedBertLoader:
                 self.pad_tokens += stats["pad_tokens"]
                 self.total_tokens += stats["total_tokens"]
                 self.n_samples += stats["n_samples"]
+                if obs_on:
+                    # Packed batches bypass DataLoader's collate metering;
+                    # account the paper's padding-efficiency quantity here
+                    # from the packer's own layout stats.
+                    obs.inc("loader_real_tokens_total",
+                            stats["total_tokens"] - stats["pad_tokens"])
+                    obs.inc("loader_padded_slots_total",
+                            stats["total_tokens"])
+                    obs.set_gauge("loader_padding_efficiency",
+                                  1.0 - self.pad_ratio)
                 yield batch
 
         def sample_len(s):

@@ -20,9 +20,33 @@ torch.utils.data.DataLoader worker *processes*
 import queue
 import threading
 
+from .. import observability as obs
 from ..resilience import faults
 from ..utils import rng as lrng
 from ..utils.logging import DatasetLogger
+
+
+def _observe_batch(batch, dt_s):
+    """Per-batch telemetry: latency histogram plus the paper's headline
+    quantity — padding efficiency = real tokens / padded slots, read off
+    the attention mask (counters accumulate the epoch totals; the gauge
+    holds the cumulative ratio). Read-only on the batch; called only when
+    telemetry is enabled."""
+    obs.observe("loader_batch_latency_seconds", dt_s)
+    obs.inc("loader_batches_total")
+    if isinstance(batch, dict) and "attention_mask" in batch:
+        mask = batch["attention_mask"]
+        real = int(mask.sum())
+        obs.inc("loader_samples_total", len(mask))
+        obs.inc("loader_real_tokens_total", real)
+        obs.inc("loader_padded_slots_total", int(mask.size))
+        reg = obs.registry()
+        padded = reg.counter("loader_padded_slots_total").total()
+        if padded:
+            reg.gauge("loader_padding_efficiency").set(
+                reg.counter("loader_real_tokens_total").total() / padded)
+    elif isinstance(batch, (list, tuple)):
+        obs.inc("loader_samples_total", len(batch))
 
 
 def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
@@ -354,11 +378,16 @@ class DataLoader:
         import warnings
         code = self._procs[w].exitcode
         restarts[w] += 1
+        obs.inc("loader_worker_deaths_total", worker=w)
         if restarts[w] > self._MAX_WORKER_RESTARTS:
+            obs.event("loader.worker_failed", worker=w, exit_code=code)
             raise RuntimeError(
                 "loader worker {} died again after a restart (last exit "
                 "code {}); failing fast — a worker that keeps dying needs "
                 "a human, not another retry".format(w, code))
+        obs.inc("loader_worker_restarts_total", worker=w)
+        obs.event("loader.worker_restart", worker=w, exit_code=code,
+                  replayed_batches=served[w])
         warnings.warn(
             "loader worker {} died (exit code {}); restarting it once and "
             "replaying its deterministic stream (discarding {} already-"
@@ -459,9 +488,30 @@ class DataLoader:
                 self._epoch_active = False
 
     def __iter__(self):
-        if self._worker_mode == "process":
-            yield from self._iter_process()
+        inner = (self._iter_process() if self._worker_mode == "process"
+                 else self._iter_thread())
+        if not obs.enabled():
+            # Telemetry off: the raw iterator, zero per-batch overhead
+            # (the no-op-mode guard in tests/test_observability.py holds
+            # the whole loader hot path to this).
+            yield from inner
             return
+        yield from self._iter_instrumented(inner)
+
+    def _iter_instrumented(self, inner):
+        """Top-level loader span + per-batch latency/padding accounting.
+        Wall time between consumer next() calls is the batch latency the
+        training loop actually experiences (prefetch included)."""
+        import time
+        with obs.span("loader.epoch", mode=self._worker_mode,
+                      batch_size=self.batch_size):
+            t0 = time.perf_counter()
+            for batch in inner:
+                _observe_batch(batch, time.perf_counter() - t0)
+                yield batch
+                t0 = time.perf_counter()
+
+    def _iter_thread(self):
         streams = self.dataset.start_epoch()
         stop = threading.Event()
         queues = [queue.Queue(maxsize=self._prefetch) for _ in streams]
@@ -527,10 +577,13 @@ class Binned:
         remaining = [len(dl.dataset) for dl in self._dataloaders]
         iters = [iter(dl) for dl in self._dataloaders]
         bin_ids = list(range(len(iters)))  # allocation-free hot loop
+        obs_on = obs.enabled()
         for i in range(len(self)):
             bin_id = lrng.choices(world_g, bin_ids, weights=remaining)[0]
             self._logger.to("rank").info(
                 "iteration {} selects bin {}".format(i, bin_id))
+            if obs_on:
+                obs.inc("loader_bin_choice_total", bin=bin_id)
             assert remaining[bin_id] > 0
             batch = next(iters[bin_id])
             remaining[bin_id] -= self._get_batch_size(batch)

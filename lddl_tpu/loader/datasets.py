@@ -92,9 +92,18 @@ class ShuffleBuffer:
         return sum(f.num_samples for f in self._files)
 
     def __iter__(self):
+        from .. import observability as obs
         buffer = []
         num_to_yield = min(self._max_num_samples_to_yield, self.num_samples)
         remaining = num_to_yield
+        # Telemetry is hoisted out of the per-sample loop: enabled() is
+        # checked once per epoch, and the fill gauge samples every 1024
+        # yields (a gauge is a level, not a rate — sampling loses nothing).
+        obs_on = obs.enabled()
+        gauge = obs.registry().gauge(
+            "loader_shuffle_buffer_fill",
+            help="shuffle-buffer occupancy / configured size") if obs_on \
+            else None
 
         for f in self._files:
             if self._logger is not None:
@@ -111,6 +120,8 @@ class ShuffleBuffer:
                         yield buffer[idx]
                         buffer[idx] = sample
                         remaining -= 1
+                        if gauge is not None and remaining % 1024 == 0:
+                            gauge.set(len(buffer) / max(self._size, 1))
                     else:
                         buffer.append(sample)
         lrng.shuffle(self._g, buffer)

@@ -104,7 +104,7 @@ class MultiHeadAttention(nn.Module):
                                      head_dim=head_dim)
         use_ring = False
         if impl == "ring" and blockwise_ok:
-            from jax.sharding import get_abstract_mesh
+            from ..parallel.compat import get_abstract_mesh
             mesh = get_abstract_mesh()
             use_ring = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
         if segments is not None and use_ring:

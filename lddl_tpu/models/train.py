@@ -17,6 +17,7 @@ import flax.linen as nn
 from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..parallel import compat
 from .bert import BertForPreTraining, axis_rules_for
 
 
@@ -179,7 +180,7 @@ def create_train_state(config, mesh, sample_batch, seed=0, optimizer=None,
             param_shardings, replicated),
         tx=tx,
     )
-    with jax.set_mesh(mesh), nn.logical_axis_rules(
+    with compat.set_mesh(mesh), nn.logical_axis_rules(
             axis_rules_for(mesh)):
         state = jax.jit(init_fn, out_shardings=shardings)(
             jax.random.PRNGKey(seed))
@@ -324,7 +325,7 @@ def make_sharded_train_step(mesh, config, model=None, ignore_index=-1,
     def wrapped(state, batch, seed=0):
         # Both contexts must be live at trace time: axis_rules resolves the
         # logical constraints, use_mesh resolves bare PartitionSpecs.
-        with jax.set_mesh(mesh), nn.logical_axis_rules(
+        with compat.set_mesh(mesh), nn.logical_axis_rules(
                 axis_rules_for(mesh)):
             return jitted(state, batch, seed)
 
@@ -360,7 +361,7 @@ def make_sharded_multi_step(mesh, config, n_steps, model=None,
     jitted = jax.jit(multi_step_fn, donate_argnums=(0,) if donate else ())
 
     def wrapped(state, batches, seed=0):
-        with jax.set_mesh(mesh), nn.logical_axis_rules(
+        with compat.set_mesh(mesh), nn.logical_axis_rules(
                 axis_rules_for(mesh)):
             return jitted(state, batches, seed)
 
@@ -397,7 +398,7 @@ def make_eval_step(mesh, config, model=None, ignore_index=-1,
     warned = [False]
 
     def wrapped(params, batch):
-        with jax.set_mesh(mesh), nn.logical_axis_rules(
+        with compat.set_mesh(mesh), nn.logical_axis_rules(
                 axis_rules_for(mesh)):
             metrics = jitted(params, batch)
         # Train steps meter mlm_dropped_labels and tolerate the 4-sigma

@@ -1,0 +1,315 @@
+"""Process-wide metrics registry: labelled counters, gauges, histograms.
+
+Telemetry backbone for every pipeline stage (preprocess, balance, loader,
+resilience). Design constraints, in priority order:
+
+1. **Inert**: instrumentation may never change pipeline behavior. No
+   metric op raises into the caller, nothing here touches any RNG stream
+   (backoff jitter, shuffle streams, masking draws are all out of reach —
+   this module never imports ``random``/``numpy.random``), and exports go
+   to a separate metrics directory, never into a shard directory.
+2. **Near-zero when disabled**: the loader's per-batch hot path calls the
+   module-level helpers below; when telemetry is off each call is one
+   env-dict lookup + an early return (same trick as resilience.faults).
+   ``enabled()`` lets per-sample loops hoist even that.
+3. **Thread-safe**: loader worker threads and the exporter thread update
+   metrics concurrently; every mutation holds the registry lock (the
+   critical sections are a dict update — nanoseconds).
+
+Enablement is ENV-VAR based (``LDDL_TPU_METRICS_DIR``) so spawned pool /
+loader worker processes inherit it automatically; ``configure()`` is the
+in-process convenience that sets the env var and (optionally) the rank
+label used in export filenames.
+
+Metric names are **stable API** (the README table documents them); spell
+them ``<stage>_<what>_<unit-suffix>`` like Prometheus conventions.
+"""
+
+import math
+import os
+import threading
+
+ENV_DIR = "LDDL_TPU_METRICS_DIR"
+ENV_RANK = "LDDL_TPU_METRICS_RANK"
+
+_lock = threading.RLock()
+# Cached enablement: (raw env value, metrics_dir or None). Re-checked on
+# every call so faults.arm()-style env flips take effect immediately.
+_cached = {"raw": object(), "dir": None}
+
+
+def metrics_dir():
+    """The active metrics directory, or None when telemetry is disabled.
+    One env-dict lookup on the cached path."""
+    raw = os.environ.get(ENV_DIR)
+    if raw != _cached["raw"]:
+        with _lock:
+            _cached["raw"] = raw
+            _cached["dir"] = raw or None
+    return _cached["dir"]
+
+
+def enabled():
+    """True when telemetry is armed (LDDL_TPU_METRICS_DIR set)."""
+    return metrics_dir() is not None
+
+
+def rank():
+    """The rank tag used in export filenames (0 unless configured)."""
+    try:
+        return int(os.environ.get(ENV_RANK, "0"))
+    except ValueError:
+        return 0
+
+
+def _labels_key(labels):
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+class _Metric:
+    """Shared storage: {labels_key: value-ish} guarded by the registry
+    lock. Subclasses define the value semantics."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._values = {}
+
+    def _items(self):
+        with _lock:
+            return list(self._values.items())
+
+
+class Counter(_Metric):
+    """Monotonic counter. ``inc`` clamps negative deltas to zero (a
+    counter that can go down is a gauge; refusing keeps exports honest)."""
+
+    kind = "counter"
+
+    def inc(self, value=1, **labels):
+        if value < 0:
+            value = 0
+        key = _labels_key(labels)
+        with _lock:
+            self._values[key] = self._values.get(key, 0) + value
+
+    def value(self, **labels):
+        with _lock:
+            return self._values.get(_labels_key(labels), 0)
+
+    def total(self):
+        with _lock:
+            return sum(self._values.values())
+
+    def snapshot(self):
+        return {"type": "counter",
+                "values": {_fmt_labels(k): v for k, v in self._items()}}
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        with _lock:
+            self._values[_labels_key(labels)] = value
+
+    def value(self, **labels):
+        with _lock:
+            return self._values.get(_labels_key(labels))
+
+    def snapshot(self):
+        return {"type": "gauge",
+                "values": {_fmt_labels(k): v for k, v in self._items()}}
+
+
+class Histogram(_Metric):
+    """Log-bucketed histogram: observations land in power-of-two buckets
+    keyed by their binary exponent (``math.frexp``), so any dynamic range
+    costs O(64) buckets and zero configuration. Tracks sum/count/min/max
+    per label set for exact means alongside the shape."""
+
+    kind = "histogram"
+
+    def observe(self, value, **labels):
+        key = _labels_key(labels)
+        v = float(value)
+        if v > 0 and not math.isinf(v):
+            b = math.frexp(v)[1]  # v in (2**(b-1), 2**b]
+        else:
+            b = None  # <=0 / non-finite: one catch-all underflow bucket
+        with _lock:
+            st = self._values.get(key)
+            if st is None:
+                st = {"count": 0, "sum": 0.0, "min": v, "max": v,
+                      "buckets": {}}
+                self._values[key] = st
+            st["count"] += 1
+            st["sum"] += v
+            if v < st["min"]:
+                st["min"] = v
+            if v > st["max"]:
+                st["max"] = v
+            st["buckets"][b] = st["buckets"].get(b, 0) + 1
+
+    def stats(self, **labels):
+        with _lock:
+            st = self._values.get(_labels_key(labels))
+            if st is None:
+                return None
+            out = dict(st)
+            out["buckets"] = dict(st["buckets"])
+            return out
+
+    def snapshot(self):
+        out = {}
+        for key, st in self._items():
+            with _lock:
+                buckets = {
+                    ("le_" + repr(2.0 ** b) if b is not None else "le_0"): n
+                    for b, n in sorted(
+                        st["buckets"].items(),
+                        key=lambda kv: (kv[0] is None, kv[0]))
+                }
+                out[_fmt_labels(key)] = {
+                    "count": st["count"], "sum": st["sum"],
+                    "min": st["min"], "max": st["max"],
+                    "mean": st["sum"] / st["count"] if st["count"] else 0.0,
+                    "buckets": buckets,
+                }
+        return {"type": "histogram", "values": out}
+
+
+def _fmt_labels(key):
+    if not key:
+        return ""
+    return ",".join("{}={}".format(k, v) for k, v in key)
+
+
+_final_export_registered = []
+
+
+def _ensure_final_export():
+    """Register a best-effort end-of-process export (once). Without this,
+    metrics recorded by short-lived processes — spawn-pool preprocess
+    workers, an env-armed CLI run that never calls write_summary() — die
+    with the process and the documented metrics-*.jsonl/.prom files never
+    appear; tracing already flushes at exit, counters must too."""
+    if _final_export_registered:
+        return
+    _final_export_registered.append(True)
+    import atexit
+
+    def _final_export():
+        try:
+            if metrics_dir() is None:
+                return
+            from . import exporters, tracing
+            exporters.export_jsonl()
+            exporters.export_prom()
+            tracing.flush()
+        except Exception:  # noqa: BLE001 - telemetry must stay inert
+            pass
+
+    atexit.register(_final_export)
+
+
+class Registry:
+    """Name -> metric map. ``counter``/``gauge``/``histogram`` create on
+    first use and return the existing metric thereafter; asking for an
+    existing name with a different type raises (a true bug at the
+    instrumentation site — the one failure this layer should not
+    swallow, and it cannot fire from a disabled run)."""
+
+    def __init__(self):
+        self._metrics = {}
+
+    def _get(self, cls, name, help):
+        with _lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, help=help)
+                self._metrics[name] = m
+                if metrics_dir() is not None:
+                    _ensure_final_export()
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    "metric {!r} already registered as {} (wanted {})"
+                    .format(name, type(m).__name__, cls.__name__))
+            return m
+
+    def counter(self, name, help=""):
+        return self._get(Counter, name, help)
+
+    def gauge(self, name, help=""):
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name, help=""):
+        return self._get(Histogram, name, help)
+
+    def names(self):
+        with _lock:
+            return sorted(self._metrics)
+
+    def get(self, name):
+        with _lock:
+            return self._metrics.get(name)
+
+    def snapshot(self):
+        """{name: {"type": ..., "values"/...}} for every metric — the
+        exporters' single source."""
+        with _lock:
+            items = list(self._metrics.items())
+        return {name: m.snapshot() for name, m in sorted(items)}
+
+    def reset(self):
+        """Drop every metric (tests and fresh benchmark runs)."""
+        with _lock:
+            self._metrics.clear()
+
+
+_REGISTRY = Registry()
+
+
+def registry():
+    return _REGISTRY
+
+
+# ---------------------------------------------------------------- helpers
+# Module-level instrumentation points. Each is a no-op after one cheap
+# enabled() check when telemetry is off, and never raises when it is on.
+
+def inc(name, value=1, **labels):
+    if metrics_dir() is None:
+        return
+    try:
+        _REGISTRY.counter(name).inc(value, **labels)
+    except TypeError:
+        raise
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass
+
+
+def set_gauge(name, value, **labels):
+    if metrics_dir() is None:
+        return
+    try:
+        _REGISTRY.gauge(name).set(value, **labels)
+    except TypeError:
+        raise
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass
+
+
+def observe(name, value, **labels):
+    if metrics_dir() is None:
+        return
+    try:
+        _REGISTRY.histogram(name).observe(value, **labels)
+    except TypeError:
+        raise
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass

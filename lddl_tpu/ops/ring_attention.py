@@ -102,7 +102,8 @@ def ring_attention(q, k, v, kv_mask, mesh, seq_axis="sp", batch_axes=None,
     heads = heads_axis if heads_axis in mesh.axis_names else None
     qkv_spec = P(batch_axes if batch_axes else None, seq_axis, heads, None)
     mask_spec = P(batch_axes if batch_axes else None, seq_axis)
-    fn = jax.shard_map(
+    from ..parallel import compat
+    fn = compat.shard_map(
         functools.partial(_ring_attention_local, axis_name=seq_axis),
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),

@@ -54,8 +54,8 @@ def make_pipelined_encoder(mesh, cfg, n_micro):
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
+    from . import compat
     from ..models.bert import EncoderLayer
 
     pp = mesh.shape["pp"]
@@ -84,9 +84,9 @@ def make_pipelined_encoder(mesh, cfg, n_micro):
         n_steps = n_micro + pp - 1
         # Carries start pp-varying (pcast) in the kernel's dtype: the loop
         # body writes stage-dependent bf16 values into them.
-        carry = jax.lax.pcast(
+        carry = compat.pcast(
             jnp.zeros(micro[0].shape, cfg.dtype), ("pp",), to="varying")
-        outputs = jax.lax.pcast(
+        outputs = compat.pcast(
             jnp.zeros(micro.shape, cfg.dtype), ("pp",), to="varying")
 
         def step(t, state):
@@ -126,8 +126,8 @@ def make_pipelined_encoder(mesh, cfg, n_micro):
     # check_vma=False: the epilogue's mask-and-psum DOES replicate the
     # output over pp, but the static varying-axis checker cannot infer
     # replication through a data-dependent mask + collective.
-    fn = shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
-                   out_specs=out_specs, check_vma=False)
+    fn = compat.shard_map(stage_fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=False)
     return fn
 
 

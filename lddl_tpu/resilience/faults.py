@@ -146,8 +146,15 @@ def _should_inject(clause, op, path):
     return clause["_rng"].random() < clause["p"]
 
 
-def _latch(clause):
+def _latch(clause, op):
     clause["_injected"] += 1
+    # Telemetry (imported lazily: observability must stay import-light for
+    # the disarmed hot path, and this only runs when a fault actually
+    # fires): injections were previously invisible outside test asserts.
+    from ..observability import event as obs_event
+    from ..observability import inc as obs_inc
+    obs_inc("resilience_faults_injected_total", op=op, kind=clause["kind"])
+    obs_event("resilience.fault_injected", op=op, kind=clause["kind"])
     if clause["flag"] is not None:
         try:
             with open(clause["flag"], "x") as f:
@@ -169,17 +176,26 @@ def fault_point(op, path=None):
             continue
         kind = clause["kind"]
         if kind == "slow":
-            _latch(clause)
+            _latch(clause, op)
             time.sleep(clause["delay"])
         elif kind == "kill":
-            _latch(clause)
+            _latch(clause, op)
+            # SIGKILL destroys the process before any atexit export runs;
+            # flush the injection record NOW or the kill is invisible in
+            # the telemetry it exists to make visible.
+            try:
+                from ..observability import exporters, tracing
+                tracing.flush()
+                exporters.export_jsonl()
+            except Exception:  # noqa: BLE001 - the kill must still fire
+                pass
             import signal
             os.kill(os.getpid(), signal.SIGKILL)
         elif kind == "truncate":
-            _latch(clause)
+            _latch(clause, op)
             action = "truncate"
         else:
-            _latch(clause)
+            _latch(clause, op)
             err = _ERRNO_OF[kind]
             raise OSError(err, "injected fault [{}] at {}".format(
                 kind, op), path)

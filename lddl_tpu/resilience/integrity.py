@@ -98,11 +98,17 @@ def build_manifest(dir_path, comm=None, log=None):
         return None
     if mode not in ("full", "size", "1"):
         mode = "full"
+    from ..observability import span as obs_span
     from ..parallel.distributed import LocalCommunicator
     comm = comm or LocalCommunicator()
     names = _parquet_basenames(dir_path)
     if not names:
         return None
+    with obs_span("resilience.build_manifest", mode=mode, shards=len(names)):
+        return _build_manifest(dir_path, comm, names, mode, log)
+
+
+def _build_manifest(dir_path, comm, names, mode, log):
     sizes = [0] * len(names)
     crcs = [0] * len(names)
     for i in range(comm.rank, len(names), comm.world_size):
@@ -183,8 +189,15 @@ def verify_shards(file_paths, on_corrupt="fail", check_crc=None, log=None,
                 on_corrupt))
     if check_crc is None:
         check_crc = os.environ.get("LDDL_TPU_VERIFY_CRC", "0") == "1"
+    from ..observability import span as obs_span
     from ..parallel.distributed import LocalCommunicator
     comm = comm or LocalCommunicator()
+    with obs_span("resilience.verify_shards", shards=len(file_paths),
+                  check_crc=check_crc):
+        return _verify_shards(file_paths, on_corrupt, check_crc, log, comm)
+
+
+def _verify_shards(file_paths, on_corrupt, check_crc, log, comm):
     manifests = {}
     for d in {os.path.dirname(p) for p in file_paths}:
         manifests[d] = read_manifest(d)
@@ -213,6 +226,12 @@ def verify_shards(file_paths, on_corrupt="fail", check_crc=None, log=None,
             good.append(path)
 
     if excluded:
+        from ..observability import event as obs_event
+        from ..observability import inc as obs_inc
+        obs_inc("resilience_corrupt_shards_total", len(excluded))
+        for p, r in excluded:
+            obs_event("resilience.corrupt_shard", path=p, reason=r[:200],
+                      policy=on_corrupt)
         lines = ["  {} -- {}".format(p, r) for p, r in excluded]
         if on_corrupt == "fail":
             raise ShardIntegrityError(
@@ -220,6 +239,7 @@ def verify_shards(file_paths, on_corrupt="fail", check_crc=None, log=None,
                 "Re-run the producing stage, or start with "
                 "on_corrupt='quarantine' to exclude them.".format(
                     len(excluded), "\n".join(lines)))
+        obs_inc("resilience_quarantined_shards_total", len(excluded))
         msg = ("QUARANTINED {} corrupt shard(s); continuing on {} "
                "surviving shard(s):\n{}".format(
                    len(excluded), len(good), "\n".join(lines)))

@@ -30,6 +30,8 @@ import random
 import time
 
 from . import faults
+from ..observability import event as obs_event
+from ..observability import inc as obs_inc
 
 # OSError errnos considered transient on shared storage: worth retrying.
 TRANSIENT_ERRNOS = frozenset(
@@ -67,14 +69,19 @@ _jitter_rng = random.Random()
 
 
 def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
-                 base_delay_s=None, max_delay_s=None, retryable=is_transient,
-                 log=None):
+                 base_delay_s=None, max_delay_s=None, retryable=is_transient):
     """Run ``fn()`` with exponential backoff + jitter + a total deadline.
 
     Retries only exceptions for which ``retryable(exc)`` is true (by
     default: transient OSErrors). The final failure re-raises the LAST
     error with the attempt history attached to its message via
     ``raise ... from`` chaining.
+
+    Every retry reports to the observability registry
+    (``resilience_retry_attempts_total{op=...}`` + a trace instant event;
+    exhaustion increments ``resilience_retry_exhausted_total``) — the
+    previously invisible retry traffic is the telemetry, the named OSError
+    below stays the failure contract.
     """
     policy = retry_policy()
     attempts = attempts if attempts is not None else policy["attempts"]
@@ -93,7 +100,9 @@ def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
             if not retryable(e):
                 raise
             elapsed = time.monotonic() - t0
+            op = desc.split(" ", 1)[0]
             if attempt >= attempts or elapsed >= deadline_s:
+                obs_inc("resilience_retry_exhausted_total", op=op)
                 raise OSError(
                     getattr(e, "errno", None) or errno.EIO,
                     "{} failed after {} attempt(s) over {:.1f}s: {}".format(
@@ -102,9 +111,9 @@ def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
             delay = min(cap, base * (2 ** (attempt - 1)))
             delay *= _jitter_rng.uniform(0.5, 1.5)
             delay = min(delay, max(0.0, deadline_s - elapsed))
-            if log is not None:
-                log("{}: transient error (attempt {}/{}), retrying in "
-                    "{:.2f}s: {}".format(desc, attempt, attempts, delay, e))
+            obs_inc("resilience_retry_attempts_total", op=op)
+            obs_event("resilience.retry", op=op, attempt=attempt,
+                      error="{}: {}".format(type(e).__name__, e)[:200])
             time.sleep(delay)
 
 

@@ -25,6 +25,7 @@ def setup():
     return cfg, mesh, batch_np, opt
 
 
+@pytest.mark.slow  # ~79s: full compile+train on CPU devices, budget-gated from tier-1
 def test_checkpoint_roundtrip_and_exact_resume(setup, tmp_path):
     cfg, mesh, batch_np, opt = setup
     ckpt = str(tmp_path / "ckpt")
@@ -59,6 +60,7 @@ def test_checkpoint_roundtrip_and_exact_resume(setup, tmp_path):
     assert float(m_resumed["loss"]) == float(m_straight["loss"])
 
 
+@pytest.mark.slow  # ~52s: full compile+train on CPU devices, budget-gated from tier-1
 def test_checkpoint_keep_prunes_old_steps(setup, tmp_path):
     cfg, mesh, batch_np, opt = setup
     ckpt = str(tmp_path / "ckpt")
@@ -74,6 +76,7 @@ def test_checkpoint_keep_prunes_old_steps(setup, tmp_path):
     assert kept == {"3", "4"}
 
 
+@pytest.mark.slow  # ~10s: full compile+train on CPU devices, budget-gated from tier-1
 def test_restore_missing_raises(setup, tmp_path):
     cfg, mesh, batch_np, opt = setup
     state, sh = create_train_state(cfg, mesh, batch_np, optimizer=opt)

@@ -204,6 +204,7 @@ def _write_unbinned_shards(tmp_path, tok, words, n=400):
     return str(out)
 
 
+@pytest.mark.slow  # ~39s: full compile+train on CPU devices, budget-gated from tier-1
 def test_packed_loader_e2e_and_train_step(packed_setup, tmp_path):
     """Full path: shards -> packed loader -> sharded train step on a mesh;
     pad ratio far below the unpacked equivalent; no sample lost."""

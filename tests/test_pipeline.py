@@ -46,6 +46,7 @@ def test_stack_roundtrip(setup):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b)[i])
 
 
+@pytest.mark.slow  # ~14s: full compile+train on CPU devices, budget-gated from tier-1
 @pytest.mark.parametrize("pp,n_micro", [(2, 2), (2, 4), (4, 4)])
 def test_pipeline_matches_reference_forward(setup, pp, n_micro):
     cfg, stacked, x, mask = setup
@@ -57,6 +58,7 @@ def test_pipeline_matches_reference_forward(setup, pp, n_micro):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~19s: full compile+train on CPU devices, budget-gated from tier-1
 def test_pipeline_matches_reference_gradients(setup):
     cfg, stacked, x, mask = setup
     mesh = make_mesh({"pp": 2, "dp": 4})

@@ -6,6 +6,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from lddl_tpu.parallel import compat
+
 
 @pytest.fixture(scope="module")
 def sp_mesh():
@@ -32,7 +34,7 @@ def test_ring_matches_dense_forward(sp_mesh):
     from lddl_tpu.ops.ring_attention import (dense_attention_reference,
                                              ring_attention)
     q, k, v, mask = _inputs()
-    with jax.set_mesh(sp_mesh):
+    with compat.set_mesh(sp_mesh):
         out = jax.jit(lambda *a: ring_attention(*a, mesh=sp_mesh))(
             q, k, v, mask)
     ref = dense_attention_reference(q, k, v, mask)
@@ -53,7 +55,7 @@ def test_ring_matches_dense_gradients(sp_mesh):
         out = dense_attention_reference(q, k, v, mask)
         return (out * out).sum()
 
-    with jax.set_mesh(sp_mesh):
+    with compat.set_mesh(sp_mesh):
         g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
     g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for gr, gd in zip(g_ring, g_dense):
@@ -61,6 +63,7 @@ def test_ring_matches_dense_gradients(sp_mesh):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # ~14s: full compile+train on CPU devices, budget-gated from tier-1
 def test_bert_ring_matches_dense_logits(sp_mesh):
     """The full model produces (numerically) the same logits under
     attention_impl='ring' and 'dense' with identical params."""
@@ -78,7 +81,7 @@ def test_bert_ring_matches_dense_logits(sp_mesh):
                                 segment_split=True)
     model_d = BertForPreTraining(cfg_dense)
     model_r = BertForPreTraining(cfg_ring)
-    with jax.set_mesh(sp_mesh), nn.logical_axis_rules(
+    with compat.set_mesh(sp_mesh), nn.logical_axis_rules(
             axis_rules_for(sp_mesh)):
         params = nn.meta.unbox(model_d.init(
             jax.random.PRNGKey(0), batch["input_ids"],
@@ -99,6 +102,7 @@ def test_bert_ring_matches_dense_logits(sp_mesh):
                                rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.slow  # ~32s: full compile+train on CPU devices, budget-gated from tier-1
 def test_ring_train_step_runs(sp_mesh):
     from lddl_tpu.loader import to_device_batch
     from lddl_tpu.models import (BertConfig, create_train_state,
@@ -119,6 +123,7 @@ def test_ring_train_step_runs(sp_mesh):
     assert int(jax.device_get(state.step)) == 1
 
 
+@pytest.mark.slow  # ~8s: full compile+train on CPU devices, budget-gated from tier-1
 def test_bart_encoder_ring_matches_dense(sp_mesh):
     """BART with attention_impl='ring' (encoder bidirectional attention
     rides the ring; decoder stays dense/causal) matches the dense model's
@@ -141,7 +146,7 @@ def test_bart_encoder_ring_matches_dense(sp_mesh):
     batch["attention_mask"][0, 20:] = 0
     model_d = BartForPreTraining(cfg_d)
     model_r = BartForPreTraining(cfg_r)
-    with jax.set_mesh(sp_mesh), nn.logical_axis_rules(
+    with compat.set_mesh(sp_mesh), nn.logical_axis_rules(
             axis_rules_for(sp_mesh)):
         params = nn.meta.unbox(model_d.init(
             jax.random.PRNGKey(0), batch["input_ids"],

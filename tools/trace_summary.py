@@ -1,0 +1,156 @@
+"""Turn lddl_tpu trace JSONL files into a per-stage wall-time table.
+
+Usage::
+
+    python tools/trace_summary.py <metrics_dir_or_trace.jsonl> [...]
+
+Reads every ``trace-*.jsonl`` under the given directories (or the files
+given directly), groups complete ("ph": "X") events by span name, and
+prints per-span and per-stage (name prefix before the first dot) rollups:
+count, total wall time, mean and max. Instant events are tallied by name.
+
+The input is the Chrome Trace Event format the observability layer emits
+(one JSON object per line; a leading ``[`` / trailing ``]`` from a
+hand-wrapped file is tolerated), so the same files open in Perfetto.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def iter_events(path):
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip().rstrip(",")
+            if not line or line in ("[", "]"):
+                continue
+            if line.startswith("["):
+                line = line[1:]
+            if line.endswith("]"):
+                line = line[:-1]
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict):
+                yield ev
+
+
+def collect(paths):
+    """{span_name: {count, total_us, max_us}}, {instant_name: count}."""
+    spans, instants = {}, {}
+    for path in paths:
+        for ev in iter_events(path):
+            ph = ev.get("ph")
+            name = ev.get("name")
+            if not name:
+                continue
+            if ph == "X":
+                st = spans.setdefault(name,
+                                      {"count": 0, "total_us": 0.0,
+                                       "max_us": 0.0})
+                dur = float(ev.get("dur", 0.0))
+                st["count"] += 1
+                st["total_us"] += dur
+                if dur > st["max_us"]:
+                    st["max_us"] = dur
+            elif ph == "i":
+                instants[name] = instants.get(name, 0) + 1
+    return spans, instants
+
+
+def stage_of(name):
+    return name.split(".", 1)[0]
+
+
+def rollup_stages(spans):
+    stages = {}
+    for name, st in spans.items():
+        agg = stages.setdefault(stage_of(name),
+                                {"count": 0, "total_us": 0.0, "max_us": 0.0})
+        agg["count"] += st["count"]
+        agg["total_us"] += st["total_us"]
+        if st["max_us"] > agg["max_us"]:
+            agg["max_us"] = st["max_us"]
+    return stages
+
+
+def _table(rows, headers):
+    widths = [max(len(str(r[i])) for r in rows + [headers])
+              for i in range(len(headers))]
+    lines = []
+    for r in [headers, ["-" * w for w in widths]] + rows:
+        lines.append("  ".join(
+            str(c).ljust(w) if i == 0 else str(c).rjust(w)
+            for i, (c, w) in enumerate(zip(r, widths))))
+    return "\n".join(lines)
+
+
+def format_summary(spans, instants):
+    def fmt_rows(d):
+        rows = []
+        for name, st in sorted(d.items(), key=lambda kv: -kv[1]["total_us"]):
+            mean_ms = st["total_us"] / st["count"] / 1e3 if st["count"] else 0
+            rows.append([name, st["count"],
+                         "{:.3f}".format(st["total_us"] / 1e6),
+                         "{:.2f}".format(mean_ms),
+                         "{:.2f}".format(st["max_us"] / 1e3)])
+        return rows
+
+    out = []
+    if spans:
+        out.append("per-stage wall time:")
+        out.append(_table(fmt_rows(rollup_stages(spans)),
+                          ["stage", "spans", "total_s", "mean_ms", "max_ms"]))
+        out.append("")
+        out.append("per-span wall time:")
+        out.append(_table(fmt_rows(spans),
+                          ["span", "count", "total_s", "mean_ms", "max_ms"]))
+    else:
+        out.append("no complete span events found")
+    if instants:
+        out.append("")
+        out.append("instant events:")
+        out.append(_table(
+            [[n, c] for n, c in sorted(instants.items(),
+                                       key=lambda kv: -kv[1])],
+            ["event", "count"]))
+    return "\n".join(out)
+
+
+def resolve_paths(args_paths):
+    paths = []
+    for p in args_paths:
+        if os.path.isdir(p):
+            paths.extend(
+                os.path.join(p, n) for n in sorted(os.listdir(p))
+                if n.startswith("trace-") and n.endswith(".jsonl"))
+        else:
+            paths.append(p)
+    return paths
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+",
+                    help="metrics dir(s) and/or trace-*.jsonl file(s)")
+    args = ap.parse_args(argv)
+    paths = resolve_paths(args.paths)
+    if not paths:
+        print("no trace files found under {}".format(args.paths),
+              file=sys.stderr)
+        return 1
+    spans, instants = collect(paths)
+    print("{} trace file(s), {} span(s), {} instant event(s)".format(
+        len(paths), sum(s["count"] for s in spans.values()),
+        sum(instants.values())))
+    print(format_summary(spans, instants))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
