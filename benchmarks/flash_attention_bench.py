@@ -81,7 +81,8 @@ def main():
         p = jax.nn.softmax(s + bias.astype(s.dtype), axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
-    g = np.random.default_rng(0)
+    from lddl_tpu.utils.rng import sample_rng
+    g = sample_rng(0)
     results = []
     # bert_base short bin, the two headline L=512 shapes, long context
     # (B=4 matches MODEL_BENCH's L=2048 row — B=1 leaves only 12 grid

@@ -112,7 +112,8 @@ def run_cli(args, timeout=None, kill_after_groups=None, out_dir=None):
 def count_spool_files(out_dir):
     spool = os.path.join(out_dir, "_shuffle")
     n = 0
-    for _, _, files in os.walk(spool):
+    # Pure count; the walk order cannot be observed.
+    for _, _, files in os.walk(spool):  # lddl: disable=unsorted-iteration
         n += len([f for f in files if not f.startswith(".")])
     return n
 
@@ -179,7 +180,8 @@ def main():
                            if n.startswith("group-")])
         rc, wall2, rss2, _ = run_cli(cli + ["--resume"], out_dir=out)
         assert rc == 0, "resume leg failed rc={}".format(rc)
-        shard_files = [n for n in os.listdir(out) if ".parquet" in n]
+        shard_files = [n for n in sorted(os.listdir(out))
+                       if ".parquet" in n]
         n_samples = 0
         import pyarrow.parquet as pq
         for n in shard_files:
@@ -251,7 +253,7 @@ def main():
         sim_wall = time.time() - t0
         assert rcs == [0, 0], "simulate legs failed: {}".format(rcs)
         sim_samples = 0
-        for name in os.listdir(sim_out):
+        for name in sorted(os.listdir(sim_out)):
             if ".parquet" in name:
                 sim_samples += pq.read_metadata(
                     os.path.join(sim_out, name)).num_rows

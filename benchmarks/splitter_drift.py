@@ -48,10 +48,10 @@ def _harvest_default_sample(max_bytes=1_500_000):
     import site
     candidates = []
     for sp in site.getsitepackages():
-        candidates += glob.glob(os.path.join(sp, "**", "*NOTICES*.txt"),
-                                recursive=True)
-        candidates += glob.glob(os.path.join(sp, "**", "LICENSE*"),
-                                recursive=True)
+        candidates += sorted(glob.glob(
+            os.path.join(sp, "**", "*NOTICES*.txt"), recursive=True))
+        candidates += sorted(glob.glob(
+            os.path.join(sp, "**", "LICENSE*"), recursive=True))
     for path in sorted(set(candidates)):
         try:
             with open(path, encoding="utf-8", errors="ignore") as f:

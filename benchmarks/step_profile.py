@@ -61,8 +61,9 @@ def component_of(tf_op):
 
 
 def parse_one_step_trace(trace_dir):
-    paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                      recursive=True)
+    # sorted(): paths[0] below must not be a filesystem-order lottery.
+    paths = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
     if not paths:
         raise RuntimeError("no chrome trace produced under " + trace_dir)
     with gzip.open(paths[0]) as f:

@@ -1,0 +1,33 @@
+"""Static analysis for the pipeline's determinism & SPMD-safety invariants.
+
+Public API::
+
+    from lddl_tpu import analysis
+    report = analysis.run_check(["lddl_tpu", "tools", "benchmarks"])
+    assert report.ok, [f.format() for f in report.new]
+
+CLI: ``python -m tools.lddl_check [paths...] [--json]`` — exits nonzero on
+any finding not in the checked-in baseline
+(``tools/lddl_check_baseline.json``) and not suppressed inline with
+``# lddl: disable=<rule>``.
+"""
+
+from .core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    Finding,
+    REPO_ROOT,
+    Report,
+    Rule,
+    all_rules,
+    analyze_source,
+    baseline_entry,
+    get_rules,
+    iter_python_files,
+    load_baseline,
+    register,
+    run_check,
+    split_baselined,
+)
+from . import rules  # noqa: F401  (imports register the rule set)
+
+RULE_IDS = tuple(r.id for r in all_rules())

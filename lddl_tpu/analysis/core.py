@@ -1,0 +1,356 @@
+"""AST-based static-analysis framework for the pipeline's invariants.
+
+The pipeline's load-bearing guarantees (rank-identical bin choice from the
+shared seeded RNG streams in ``utils/rng.py``, byte-identical resume,
+atomic-only publish into shard directories — SURVEY §0) used to be enforced
+by two grep-style lint tests plus reviewer vigilance. This package turns
+them into machine-checked rules that run over the whole source tree on
+every test run (``tests/test_analysis.py``) and from the CLI
+(``python -m tools.lddl_check``).
+
+Framework pieces:
+
+- :class:`Rule` — an AST visitor with an id, a docstring explaining what it
+  protects, optional ``allow`` (fnmatch patterns of repo-relative paths the
+  rule never fires on) and ``only`` (patterns it is restricted to).
+- registry — rules self-register via :func:`register`; :func:`get_rules`
+  resolves an optional name filter.
+- suppressions — ``# lddl: disable=<rule>[,<rule>...]`` on the flagged
+  line, or on a comment-only line directly above it, silences a finding.
+  Every suppression should carry a justification in the surrounding
+  comment; they are grep-able so reviewers can audit the full set.
+- baseline — a checked-in JSON file of grandfathered findings (each with a
+  one-line ``reason``). A finding matches a baseline entry on
+  ``(rule, path, stripped source line)`` so entries survive unrelated line
+  drift. ``lddl_check`` exits nonzero only on NEW findings.
+- output — human-readable ``path:line: [rule] message`` lines or ``--json``
+  for machine consumption (the CI test parses it).
+"""
+
+import ast
+import fnmatch
+import json
+import os
+import re
+
+# Repo root = dirname of the package that contains lddl_tpu/.
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_BASELINE = os.path.join("tools", "lddl_check_baseline.json")
+
+# The directive may sit anywhere inside a comment ("# why ... lddl:
+# disable=x"), but must be after a '#' so string literals never suppress.
+_SUPPRESS_RE = re.compile(r"#.*?lddl:\s*disable=([A-Za-z0-9_\-, ]+)")
+
+
+class Finding(object):
+    """One rule violation at one source location."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "snippet")
+
+    def __init__(self, rule, path, line, col, message, snippet=""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+        self.snippet = snippet
+
+    def key(self):
+        """Baseline identity: stable under unrelated line-number drift."""
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self):
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet}
+
+    def format(self):
+        return "{}:{}: [{}] {}".format(self.path, self.line, self.rule,
+                                       self.message)
+
+    def __repr__(self):
+        return "Finding({})".format(self.format())
+
+
+class Context(object):
+    """Everything a rule needs about one source file: the parsed tree, a
+    parent map (child AST node -> parent), the raw lines, and an
+    import-alias resolver so ``np.random.default_rng`` and
+    ``numpy.random.default_rng`` normalize to one dotted name."""
+
+    def __init__(self, path, source, tree):
+        self.path = path  # repo-relative, posix separators
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.aliases = _import_aliases(tree)
+
+    def snippet_at(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def finding(self, rule_id, node, message):
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule_id, self.path, line, col, message,
+                       self.snippet_at(line))
+
+    def resolve_call(self, node):
+        """Normalized dotted name of a Call's callee, or None.
+
+        Only pure ``Name(.Attribute)*`` chains resolve; the head segment is
+        mapped through the module's import aliases (``import numpy as np``
+        makes ``np.random.seed`` -> ``numpy.random.seed``; ``from datetime
+        import datetime`` makes ``datetime.now`` -> ``datetime.datetime.now``).
+        """
+        return self.resolve_name(node.func)
+
+    def resolve_name(self, node):
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        parts.reverse()
+        head = self.aliases.get(parts[0], parts[0])
+        return ".".join([head] + parts[1:])
+
+    def ancestors(self, node):
+        while node in self.parents:
+            node = self.parents[node]
+            yield node
+
+
+def _import_aliases(tree):
+    """{local name: canonical dotted module/attr} from top-level-ish
+    imports anywhere in the tree (function-local imports included —
+    this codebase lazy-imports jax deliberately)."""
+    aliases = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                local = a.asname or a.name.split(".")[0]
+                aliases[local] = a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            # Relative imports keep just the module path ("..resilience.io"
+            # -> "resilience.io"): rules match on suffixes of package-local
+            # names, absolute prefixes on external ones.
+            mod = (node.module or "").lstrip(".")
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                local = a.asname or a.name
+                aliases[local] = "{}.{}".format(mod, a.name) if mod else a.name
+    return aliases
+
+
+class Rule(object):
+    """Base class: subclasses set ``id``, ``doc`` (what the rule protects,
+    one line — surfaced by ``lddl_check --list-rules`` and the README
+    table) and implement :meth:`run` yielding Findings."""
+
+    id = None
+    doc = ""
+    # fnmatch patterns (repo-relative posix paths) the rule never fires on.
+    allow = ()
+    # If set, the rule only runs on files matching one of these patterns.
+    only = None
+
+    def applies_to(self, path):
+        if self.only is not None and not _match_any(path, self.only):
+            return False
+        return not _match_any(path, self.allow)
+
+    def run(self, ctx):
+        raise NotImplementedError
+
+
+def _match_any(path, patterns):
+    return any(fnmatch.fnmatch(path, pat) for pat in patterns)
+
+
+_REGISTRY = []
+
+
+def register(cls):
+    """Class decorator: add a Rule subclass to the global registry."""
+    assert cls.id, "rule must define an id"
+    assert all(r.id != cls.id for r in _REGISTRY), \
+        "duplicate rule id {}".format(cls.id)
+    _REGISTRY.append(cls())
+    return cls
+
+
+def all_rules():
+    return list(_REGISTRY)
+
+
+def get_rules(names=None):
+    """Resolve a rule-name filter (iterable of ids, or None for all)."""
+    if names is None:
+        return all_rules()
+    names = set(names)
+    unknown = names - {r.id for r in _REGISTRY}
+    if unknown:
+        raise ValueError("unknown rule id(s): {}; known: {}".format(
+            sorted(unknown), sorted(r.id for r in _REGISTRY)))
+    return [r for r in _REGISTRY if r.id in names]
+
+
+def suppressions(lines):
+    """{lineno: set(rule ids)} from ``# lddl: disable=...`` comments. A
+    directive on a code line covers that line; a directive on a
+    comment-only line covers the next line as well."""
+    supp = {}
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = {part.strip() for part in m.group(1).split(",") if part.strip()}
+        supp.setdefault(i, set()).update(ids)
+        if text.lstrip().startswith("#"):
+            supp.setdefault(i + 1, set()).update(ids)
+    return supp
+
+
+def analyze_source(source, path, rules=None):
+    """Run ``rules`` over one in-memory source file.
+
+    ``path`` is the repo-relative posix path the rules see (allow/only
+    lists match against it). Returns (findings, suppressed) — findings
+    survive suppression comments; suppressed did not."""
+    rules = all_rules() if rules is None else rules
+    tree = ast.parse(source, filename=path)
+    ctx = Context(path, source, tree)
+    supp = suppressions(ctx.lines)
+    findings, suppressed = [], []
+    for rule in rules:
+        if not rule.applies_to(path):
+            continue
+        for f in rule.run(ctx):
+            if f.rule in supp.get(f.line, ()):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, suppressed
+
+
+def iter_python_files(paths, root=None):
+    """Yield (abs path, repo-relative posix path) for every .py under
+    ``paths`` (files or directories), in sorted order — the walk itself
+    must not leak filesystem order."""
+    root = root or REPO_ROOT
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(ap):
+            # A typo'd path must not make the gate silently green.
+            raise FileNotFoundError(
+                "lddl-check path does not exist: {}".format(ap))
+        if os.path.isfile(ap):
+            yield ap, _relpath(ap, root)
+            continue
+        # Deterministic walk: dirnames sorted in place, filenames sorted
+        # below — the FS order never escapes this loop.
+        for dirpath, dirnames, filenames in os.walk(ap):  # lddl: disable=unsorted-iteration
+            dirnames.sort()
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    fp = os.path.join(dirpath, name)
+                    yield fp, _relpath(fp, root)
+
+
+def _relpath(path, root):
+    rel = os.path.relpath(os.path.abspath(path), root)
+    return rel.replace(os.sep, "/")
+
+
+def load_baseline(path):
+    """[{rule, path, match, reason}, ...] from the baseline JSON (absent
+    file reads as empty)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    entries = data.get("entries", []) if isinstance(data, dict) else data
+    return [e for e in entries if isinstance(e, dict)]
+
+
+def baseline_entry(finding, reason=""):
+    return {"rule": finding.rule, "path": finding.path,
+            "match": finding.snippet, "reason": reason}
+
+
+def split_baselined(findings, entries):
+    """Partition findings into (new, baselined) against baseline entries.
+    Each entry absorbs any number of findings with the same
+    (rule, path, stripped-line) identity."""
+    keys = {(e.get("rule"), e.get("path"), e.get("match")) for e in entries}
+    new, old = [], []
+    for f in findings:
+        (old if f.key() in keys else new).append(f)
+    return new, old
+
+
+class Report(object):
+    """Result of a tree-wide run: new findings, baselined findings,
+    inline-suppressed findings, parse errors, files analyzed."""
+
+    def __init__(self):
+        self.new = []
+        self.baselined = []
+        self.suppressed = []
+        self.errors = []  # (path, message)
+        self.files = 0
+
+    @property
+    def ok(self):
+        return not self.new and not self.errors
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "files": self.files,
+            "findings": [f.to_dict() for f in self.new],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "errors": [{"path": p, "message": m} for p, m in self.errors],
+        }
+
+
+def run_check(paths, rules=None, baseline_path=None, root=None):
+    """Analyze every .py under ``paths`` and return a :class:`Report`.
+
+    ``baseline_path`` defaults to the checked-in
+    ``tools/lddl_check_baseline.json`` (pass ``baseline_path=""`` to run
+    without a baseline)."""
+    root = root or REPO_ROOT
+    rules = all_rules() if rules is None else rules
+    if baseline_path is None:
+        baseline_path = os.path.join(root, DEFAULT_BASELINE)
+    entries = load_baseline(baseline_path) if baseline_path else []
+    report = Report()
+    for abspath, relpath in iter_python_files(paths, root=root):
+        report.files += 1
+        try:
+            with open(abspath, "r", encoding="utf-8") as f:
+                source = f.read()
+            findings, suppressed = analyze_source(source, relpath, rules)
+        except SyntaxError as e:
+            report.errors.append((relpath, "syntax error: {}".format(e)))
+            continue
+        report.suppressed.extend(suppressed)
+        new, old = split_baselined(findings, entries)
+        report.new.extend(new)
+        report.baselined.extend(old)
+    return report

@@ -187,7 +187,9 @@ def _count_bytes_rewritten(path):
         return
     try:
         obs.inc("balance_bytes_rewritten_total", os.stat(path).st_size)
-    except OSError:
+    # Telemetry-only stat of a file this rank just wrote; a racing stat
+    # failure must not fail the balance.
+    except OSError:  # lddl: disable=swallowed-error
         pass
 
 
@@ -301,7 +303,7 @@ def balance_shards(in_dir, out_dir, num_shards, comm=None, log=None,
 
 def _balance_shards_body(in_dir, out_dir, num_shards, comm, log, stats):
     if os.path.isdir(out_dir):
-        stale = [n for n in os.listdir(out_dir) if ".parquet" in n]
+        stale = [n for n in sorted(os.listdir(out_dir)) if ".parquet" in n]
         if stale:
             raise ValueError(
                 "output dir {} already contains {} shard files (e.g. {}); "

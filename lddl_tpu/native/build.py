@@ -47,7 +47,9 @@ def _lib_meta_tag():
                         tag += ";cpuflags=" + hashlib.sha256(
                             line.encode()).hexdigest()[:12]
                         break
-        except OSError:
+        # /proc/cpuinfo probe is a cache-tag refinement; absent (non-Linux)
+        # just means a coarser tag.
+        except OSError:  # lddl: disable=swallowed-error
             pass
     return tag
 

@@ -18,8 +18,8 @@ import dataclasses
 import os
 
 import pyarrow as pa
-import pyarrow.parquet as pq
 
+from ..resilience.io import atomic_write, write_table_atomic
 from ..utils import rng as lrng
 from .binning import DEFAULT_PARQUET_COMPRESSION
 from .sentences import split_sentences, split_sentences_learned
@@ -105,15 +105,13 @@ class BartBucketProcessor:
         os.makedirs(self.out_dir, exist_ok=True)
         if self.output_format == "txt":
             path = os.path.join(self.out_dir, "{}.txt".format(bucket))
-            with open(path, "w", encoding="utf-8") as f:
-                for r in rows:
-                    f.write(r + "\n")
+            atomic_write(path, "".join(r + "\n" for r in rows))
             return {path: len(rows)}
         path = os.path.join(self.out_dir, "part.{}.parquet".format(bucket))
         table = pa.table({"sentences": rows},
                          schema=pa.schema([("sentences", pa.string())]))
-        pq.write_table(table, path,
-                       compression=DEFAULT_PARQUET_COMPRESSION)
+        write_table_atomic(table, path,
+                           compression=DEFAULT_PARQUET_COMPRESSION)
         return {path: len(rows)}
 
 

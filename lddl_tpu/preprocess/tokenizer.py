@@ -156,7 +156,6 @@ def build_wordpiece_vocab(texts, out_path, vocab_size=30000,
                 pair_counts.pop(p, None)
                 postings.pop(p, None)
 
-    with open(out_path, "w", encoding="utf-8") as f:
-        for t in vocab:
-            f.write(t + "\n")
+    from ..resilience.io import atomic_write
+    atomic_write(out_path, "".join(t + "\n" for t in vocab))
     return out_path

@@ -67,10 +67,10 @@ def shard_checksum(path):
 def _parquet_basenames(dir_path):
     from ..utils.fs import _is_parquet_path
     try:
-        names = os.listdir(dir_path)
+        names = sorted(os.listdir(dir_path))
     except OSError:
         return []
-    return sorted(n for n in names if _is_parquet_path(n))
+    return [n for n in names if _is_parquet_path(n)]
 
 
 def read_manifest(dir_path):

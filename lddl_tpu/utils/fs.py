@@ -137,7 +137,7 @@ def num_samples_cache_is_stale(dir_path, cache):
     if cache is None:
         return True
     try:
-        names = os.listdir(dir_path)
+        names = sorted(os.listdir(dir_path))
     except OSError:
         return True
     on_disk = {n for n in names if _is_parquet_path(n)}

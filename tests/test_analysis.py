@@ -1,0 +1,506 @@
+"""The determinism / SPMD-safety analyzer (lddl_tpu/analysis).
+
+Three layers:
+
+1. Framework mechanics — suppressions, baseline matching, JSON output,
+   exit codes.
+2. Per-rule fixtures — every rule gets at least one true-positive bad
+   snippet AND one suppressed/allowlisted case, so reintroducing any
+   guarded pattern anywhere in the tree demonstrably fails CI.
+3. The CI gate — a full run over lddl_tpu/, tools/, and benchmarks/
+   must produce zero non-baselined findings, with a bounded, justified
+   baseline; plus ordered-iteration determinism proofs for the shard
+   enumeration paths the rule audited.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from lddl_tpu import analysis
+
+REPO_ROOT = analysis.REPO_ROOT
+
+
+def check(source, path, rules=None):
+    """Findings for one in-memory snippet under a virtual repo path."""
+    findings, _ = analysis.analyze_source(
+        textwrap.dedent(source), path,
+        analysis.get_rules(rules) if rules else None)
+    return findings
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------- framework
+
+
+def test_every_rule_is_registered_once():
+    ids = [r.id for r in analysis.all_rules()]
+    assert len(ids) == len(set(ids))
+    assert set(ids) == {
+        "global-rng", "wall-clock", "atomic-publish", "unsorted-iteration",
+        "swallowed-error", "stage-span", "jit-host-effect",
+        "manifest-determinism",
+    }
+
+
+def test_unknown_rule_filter_raises():
+    with pytest.raises(ValueError, match="unknown rule"):
+        analysis.get_rules(["no-such-rule"])
+
+
+def test_inline_suppression_same_line():
+    src = "import os\nnames = os.listdir(d)  # lddl: disable=unsorted-iteration\n"
+    findings, suppressed = analysis.analyze_source(src, "lddl_tpu/x.py")
+    assert rule_ids(findings) == []
+    assert rule_ids(suppressed) == ["unsorted-iteration"]
+
+
+def test_inline_suppression_comment_line_above():
+    src = ("import os\n"
+           "# why: count only -- lddl: disable=unsorted-iteration\n"
+           "names = os.listdir(d)\n")
+    findings, suppressed = analysis.analyze_source(src, "lddl_tpu/x.py")
+    assert rule_ids(findings) == []
+    assert rule_ids(suppressed) == ["unsorted-iteration"]
+
+
+def test_suppression_is_rule_specific():
+    src = "import os\nnames = os.listdir(d)  # lddl: disable=wall-clock\n"
+    findings, _ = analysis.analyze_source(src, "lddl_tpu/x.py")
+    assert rule_ids(findings) == ["unsorted-iteration"]
+
+
+def test_baseline_matches_on_rule_path_and_line_text():
+    src = "import os\nnames = os.listdir(d)\n"
+    findings, _ = analysis.analyze_source(src, "lddl_tpu/x.py")
+    [f] = findings
+    entry = analysis.baseline_entry(f, "grandfathered")
+    new, old = analysis.split_baselined([f], [entry])
+    assert (new, old) == ([], [f])
+    # A different line text (the code changed) is a NEW finding again.
+    entry2 = dict(entry, match="something_else()")
+    new, old = analysis.split_baselined([f], [entry2])
+    assert (new, old) == ([f], [])
+
+
+# ---------------------------------------------------------- rule fixtures
+
+
+def test_global_rng_true_positives():
+    src = """
+    import random
+    import numpy as np
+
+    def pick(files):
+        random.shuffle(files)
+        g = np.random.default_rng(0)
+        np.random.seed(1)
+        return files
+    """
+    ids = rule_ids(check(src, "lddl_tpu/loader/x.py", ["global-rng"]))
+    assert ids == ["global-rng"] * 3
+
+
+def test_global_rng_allows_keyed_streams_and_allowlisted_files():
+    src = """
+    import numpy as np
+    from lddl_tpu.utils.rng import sample_rng
+
+    def pick(seed):
+        g = sample_rng(seed)          # keyed stream: fine
+        r = g.random(4)               # method on a Generator: fine
+        k = np.random.Philox(key=[1]) # explicit keying: fine
+        return r, k
+    """
+    assert check(src, "lddl_tpu/loader/x.py", ["global-rng"]) == []
+    # The allowlisted owners may construct whatever they need.
+    bad = "import numpy as np\ng = np.random.default_rng(0)\n"
+    assert check(bad, "lddl_tpu/utils/rng.py", ["global-rng"]) == []
+    assert check(bad, "lddl_tpu/models/testing.py", ["global-rng"]) == []
+
+
+def test_wall_clock_true_positive_and_aliased_import():
+    src = """
+    import time
+    from datetime import datetime
+
+    def shard_name(i):
+        return "shard-{}-{}".format(i, time.time())
+
+    def stamp():
+        return datetime.now()
+    """
+    ids = rule_ids(check(src, "lddl_tpu/preprocess/x.py", ["wall-clock"]))
+    assert ids == ["wall-clock"] * 2
+
+
+def test_wall_clock_allows_observability_and_monotonic():
+    bad = "import time\nts = time.time()\n"
+    assert check(bad, "lddl_tpu/observability/tracing.py",
+                 ["wall-clock"]) == []
+    assert check(bad, "benchmarks/foo_bench.py", ["wall-clock"]) == []
+    ok = "import time\nt0 = time.monotonic()\nt1 = time.perf_counter()\n"
+    assert check(ok, "lddl_tpu/preprocess/x.py", ["wall-clock"]) == []
+
+
+def test_atomic_publish_flags_moves_everywhere():
+    src = """
+    import os
+    import shutil
+
+    def publish(tmp, dst):
+        os.replace(tmp, dst)
+        os.rename(tmp, dst)
+        shutil.move(tmp, dst)
+    """
+    ids = rule_ids(check(src, "lddl_tpu/preprocess/x.py",
+                         ["atomic-publish"]))
+    assert ids == ["atomic-publish"] * 3
+    # ...including outside the shard packages (the old grep lint's scope).
+    ids = rule_ids(check(src, "lddl_tpu/observability/x.py",
+                         ["atomic-publish"]))
+    assert ids == ["atomic-publish"] * 3
+
+
+def test_atomic_publish_flags_raw_parquet_and_write_open():
+    src = """
+    import pyarrow.parquet as pq
+
+    def sink(table, path, rows):
+        pq.write_table(table, path)
+        with open(path + ".txt", "w") as f:
+            f.write(rows)
+    """
+    ids = rule_ids(check(src, "lddl_tpu/preprocess/x.py",
+                         ["atomic-publish"]))
+    assert ids == ["atomic-publish"] * 2
+
+
+def test_atomic_publish_allows_resilience_io_and_reads():
+    src = "import os\nos.replace('a', 'b')\n"
+    assert check(src, "lddl_tpu/resilience/io.py", ["atomic-publish"]) == []
+    ok = "rows = open(path).read()\nmore = open(path, 'rb').read()\n"
+    assert check(ok, "lddl_tpu/preprocess/x.py", ["atomic-publish"]) == []
+
+
+def test_unsorted_iteration_true_positives():
+    src = """
+    import glob
+    import os
+
+    def shards(d):
+        return [n for n in os.listdir(d) if ".parquet" in n]
+
+    def parts(d):
+        for p in glob.glob(d + "/part.*"):
+            yield p
+    """
+    ids = rule_ids(check(src, "lddl_tpu/balance/x.py",
+                         ["unsorted-iteration"]))
+    assert ids == ["unsorted-iteration"] * 2
+
+
+def test_unsorted_iteration_allows_sorted_and_reductions():
+    src = """
+    import glob
+    import os
+
+    def shards(d):
+        return sorted(n for n in os.listdir(d) if ".parquet" in n)
+
+    def count(d):
+        return len(os.listdir(d))
+
+    def names(d):
+        return set(os.listdir(d)) | {s for s in glob.glob(d + "/*")}
+    """
+    assert check(src, "lddl_tpu/balance/x.py", ["unsorted-iteration"]) == []
+
+
+def test_swallowed_error_true_positives():
+    src = """
+    def load(path):
+        try:
+            return open(path).read()
+        except:
+            return None
+
+    def sweep(path):
+        import os
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+    """
+    ids = rule_ids(check(src, "lddl_tpu/loader/x.py", ["swallowed-error"]))
+    assert ids == ["swallowed-error"] * 2
+
+
+def test_swallowed_error_allows_handled_oserror():
+    src = """
+    def read_or_default(path):
+        try:
+            return open(path).read()
+        except OSError:
+            return ""
+    """
+    assert check(src, "lddl_tpu/loader/x.py", ["swallowed-error"]) == []
+
+
+def test_stage_span_missing_span_is_flagged():
+    src = """
+    def balance_shards(in_dir, out_dir):
+        return do_work(in_dir, out_dir)
+    """
+    ids = rule_ids(check(src, "lddl_tpu/balance/balancer.py",
+                         ["stage-span"]))
+    assert ids == ["stage-span"]
+    # Non-entry files carry no span obligation.
+    assert check(src, "lddl_tpu/balance/other.py", ["stage-span"]) == []
+
+
+def test_stage_span_present_span_passes():
+    src = """
+    from .. import observability as obs
+
+    def balance_shards(in_dir, out_dir):
+        with obs.span("balance.run"):
+            return do_work(in_dir, out_dir)
+    """
+    assert check(src, "lddl_tpu/balance/balancer.py", ["stage-span"]) == []
+
+
+def test_jit_host_effect_true_positives():
+    src = """
+    import functools
+    import jax
+    from .. import observability as obs
+
+    def _impl(x, scale):
+        print("tracing", x)
+        obs.inc("steps_total")
+        return float(x) * scale
+
+    def make(scale):
+        impl = functools.partial(_impl, scale=scale)
+        return jax.jit(impl)
+
+    @jax.jit
+    def decorated(x):
+        import time
+        t = time.perf_counter()
+        return x * t
+    """
+    ids = rule_ids(check(src, "lddl_tpu/ops/x.py", ["jit-host-effect"]))
+    assert sorted(ids) == ["jit-host-effect"] * 4
+
+
+def test_jit_host_effect_ignores_unjitted_and_other_packages():
+    src = """
+    def helper(x):
+        print("host-side is fine here")
+        return float(x)
+    """
+    assert check(src, "lddl_tpu/ops/x.py", ["jit-host-effect"]) == []
+    jit_src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        print(x)
+        return x
+    """
+    # Rule is scoped to ops/ and models/ only.
+    assert check(jit_src, "lddl_tpu/loader/x.py", ["jit-host-effect"]) == []
+    assert rule_ids(check(jit_src, "lddl_tpu/models/x.py",
+                          ["jit-host-effect"])) == ["jit-host-effect"]
+
+
+def test_manifest_determinism_true_positive():
+    src = """
+    import os
+    import time
+
+    def build_manifest(names):
+        return {"at": time.time(), "pid": os.getpid(),
+                "shards": sorted(names)}
+
+    def _ledger_write(out_dir, written):
+        import uuid
+        return {"id": str(uuid.uuid4()), "written": written}
+    """
+    ids = rule_ids(check(src, "lddl_tpu/resilience/x.py",
+                         ["manifest-determinism"]))
+    assert ids == ["manifest-determinism"] * 3
+
+
+def test_manifest_determinism_ignores_other_functions():
+    src = """
+    import time
+
+    def progress_meter():
+        return time.time()
+    """
+    assert check(src, "lddl_tpu/resilience/x.py",
+                 ["manifest-determinism"]) == []
+
+
+# ------------------------------------------------------------ the CI gate
+
+
+def test_full_tree_has_zero_non_baselined_findings():
+    """THE gate: every invariant holds over lddl_tpu/, tools/, and
+    benchmarks/ right now, modulo the committed, justified baseline."""
+    report = analysis.run_check(["lddl_tpu", "tools", "benchmarks"])
+    assert report.errors == []
+    assert report.new == [], "\n".join(f.format() for f in report.new)
+
+
+def test_baseline_is_bounded_and_justified():
+    entries = analysis.load_baseline(
+        os.path.join(REPO_ROOT, analysis.DEFAULT_BASELINE))
+    assert 0 < len(entries) <= 10
+    for e in entries:
+        assert e.get("reason", "").strip(), \
+            "baseline entry without a justification: {}".format(e)
+        assert "TODO" not in e["reason"]
+
+
+def test_introducing_a_bad_snippet_fails_the_tree(tmp_path):
+    """End-to-end: drop one bad fixture file into an analyzed tree and the
+    checker (API and CLI alike) must go red."""
+    pkg = tmp_path / "lddl_tpu_fixture"
+    pkg.mkdir()
+    bad = pkg / "regression.py"
+    bad.write_text("import os\n\n"
+                   "def publish(tmp, dst):\n"
+                   "    os.replace(tmp, dst)\n")
+    report = analysis.run_check([str(bad)], root=str(tmp_path))
+    assert [f.rule for f in report.new] == ["atomic-publish"]
+    assert not report.ok
+
+
+def test_cli_json_mode_and_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lddl_check", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["findings"] == []
+    assert payload["files"] > 50
+    assert len(payload["baselined"]) <= 10
+
+    # A tree with a violation exits 1 and reports it in JSON.
+    bad = tmp_path / "bad.py"
+    bad.write_text("import random\nrandom.shuffle([1, 2])\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lddl_check", str(bad),
+         "--baseline", "", "--json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, env=env)
+    assert proc.returncode == 1
+    payload = json.loads(proc.stdout)
+    assert [f["rule"] for f in payload["findings"]] == ["global-rng"]
+
+
+def test_nonexistent_path_is_a_loud_error():
+    """A typo'd path must not make the gate silently green (0 files,
+    exit 0)."""
+    with pytest.raises(FileNotFoundError, match="lddl_tpuu"):
+        analysis.run_check(["lddl_tpuu"])
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.lddl_check", "lddl_tpuu"],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 2
+    assert "does not exist" in proc.stderr
+
+
+def test_write_baseline_refuses_filtered_runs(tmp_path):
+    """--write-baseline from a --rules/paths-filtered run would silently
+    drop every grandfathered entry outside the filter."""
+    baseline = tmp_path / "b.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    for extra in (["--rules", "wall-clock"], ["lddl_tpu"]):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.lddl_check", "--write-baseline",
+             "--baseline", str(baseline)] + extra,
+            cwd=REPO_ROOT, capture_output=True, text=True, env=env)
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "full run" in proc.stderr
+        assert not baseline.exists()
+
+
+def test_ci_check_script():
+    """The fast tier-1 static gate: analyzer + syntax pass."""
+    proc = subprocess.run(
+        ["bash", os.path.join(REPO_ROOT, "tools", "ci_check.sh")],
+        cwd=REPO_ROOT, capture_output=True, text=True,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ci_check: OK" in proc.stdout
+
+
+# ----------------------------- ordered-iteration determinism (satellite)
+
+
+def test_shard_enumeration_is_immune_to_filesystem_order(monkeypatch):
+    """Satellite proof: the shard-listing helpers cannot leak FS order.
+    os.walk/os.listdir are patched to yield entries REVERSED; every
+    enumeration the pipeline consumes must come back sorted anyway."""
+    from lddl_tpu.resilience import integrity
+    from lddl_tpu.utils import fs
+
+    real_walk, real_listdir = os.walk, os.listdir
+
+    def reversed_walk(top, **kw):
+        for dirpath, dirnames, filenames in real_walk(top, **kw):
+            yield dirpath, list(reversed(sorted(dirnames))), \
+                list(reversed(sorted(filenames)))
+
+    def reversed_listdir(path):
+        return list(reversed(sorted(real_listdir(path))))
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        for name in ("part.2.parquet", "part.0.parquet", "part.1.parquet",
+                     ".num_samples.json"):
+            with open(os.path.join(d, name), "w") as f:
+                f.write("x")
+        monkeypatch.setattr(os, "walk", reversed_walk)
+        monkeypatch.setattr(os, "listdir", reversed_listdir)
+
+        paths = fs.get_all_parquets_under(d)
+        assert paths == sorted(paths) and len(paths) == 3
+
+        names = integrity._parquet_basenames(d)
+        assert names == ["part.0.parquet", "part.1.parquet",
+                         "part.2.parquet"]
+
+
+def test_balancer_stale_guard_reports_deterministically(monkeypatch,
+                                                        tmp_path):
+    """balance/balancer.py's dirty-output guard (the audited site) now
+    sorts its listing: the reported example shard is the lexicographic
+    first regardless of FS enumeration order."""
+    from lddl_tpu.balance.balancer import balance_shards
+
+    out = tmp_path / "out"
+    out.mkdir()
+    for name in ("zzz.parquet", "aaa.parquet"):
+        (out / name).write_text("x")
+    real_listdir = os.listdir
+    monkeypatch.setattr(
+        os, "listdir",
+        lambda p: list(reversed(sorted(real_listdir(p)))))
+    with pytest.raises(ValueError, match=r"e\.g\. aaa\.parquet"):
+        balance_shards(str(tmp_path / "nothing"), str(out), 2)

@@ -202,24 +202,29 @@ def test_export_failure_is_inert(tmp_path):
 
 
 def test_every_stage_entry_point_opens_a_top_level_span():
-    """Grep-style lint (same style as the atomic-write lint in
-    test_resilience.py): the public entry point of each pipeline stage
-    must open its top-level span, so traces always carry the stage
-    skeleton. The span names are stable API (README table)."""
-    import lddl_tpu
-    pkg_root = os.path.dirname(lddl_tpu.__file__)
-    required = {
-        os.path.join("preprocess", "runner.py"): 'span("preprocess.run"',
-        os.path.join("balance", "balancer.py"): 'span("balance.run"',
-        os.path.join("loader", "dataloader.py"): 'span("loader.epoch"',
+    """The public entry point of each pipeline stage must open its
+    top-level span, so traces always carry the stage skeleton. The span
+    names are stable API (README table). Migrated from a grep to the AST
+    analyzer's stage-span rule (single source of truth — see
+    tests/test_analysis.py)."""
+    from lddl_tpu import analysis
+    from lddl_tpu.analysis.rules import STAGE_SPANS
+    assert set(STAGE_SPANS.items()) == {
+        ("lddl_tpu/preprocess/runner.py", "preprocess.run"),
+        ("lddl_tpu/balance/balancer.py", "balance.run"),
+        ("lddl_tpu/loader/dataloader.py", "loader.epoch"),
     }
-    missing = []
-    for rel, needle in required.items():
-        with open(os.path.join(pkg_root, rel), encoding="utf-8") as f:
-            if needle not in f.read():
-                missing.append("{} lacks {}".format(rel, needle))
-    assert missing == [], (
-        "stage entry points without a top-level span: {}".format(missing))
+    report = analysis.run_check(
+        ["lddl_tpu"], rules=analysis.get_rules(["stage-span"]))
+    assert report.errors == []
+    assert report.new == [], (
+        "stage entry points without a top-level span:\n{}".format(
+            "\n".join(f.format() for f in report.new)))
+    # The rule still fails a stage file that loses its span.
+    findings, _ = analysis.analyze_source(
+        "def balance_shards(a, b):\n    return None\n",
+        "lddl_tpu/balance/balancer.py", analysis.get_rules(["stage-span"]))
+    assert [f.rule for f in findings] == ["stage-span"]
 
 
 # ------------------------------------------------------ trace_summary

@@ -607,23 +607,20 @@ def test_no_raw_os_replace_outside_resilience_io():
     """Every publish into a shard directory must go through
     resilience.io.atomic_write/atomic_publish (fsync + replace + dir
     fsync). A raw os.replace elsewhere re-opens the torn-publish window
-    this PR closed."""
-    import lddl_tpu
-    pkg_root = os.path.dirname(lddl_tpu.__file__)
-    allowed = {os.path.join("resilience", "io.py")}
-    offenders = []
-    for dirpath, _, filenames in os.walk(pkg_root):
-        for name in filenames:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, pkg_root)
-            if rel in allowed:
-                continue
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-            if "os.replace(" in src:
-                offenders.append(rel)
-    assert offenders == [], (
-        "raw os.replace( outside resilience/io.py in: {} -- route these "
-        "through resilience.io.atomic_write/atomic_publish".format(offenders))
+    this PR closed. Migrated from a grep to the AST analyzer's
+    atomic-publish rule (single source of truth, also catches os.rename /
+    shutil.move / raw write-mode opens — see tests/test_analysis.py)."""
+    from lddl_tpu import analysis
+    report = analysis.run_check(
+        ["lddl_tpu"], rules=analysis.get_rules(["atomic-publish"]))
+    assert report.errors == []
+    assert report.new == [], (
+        "raw publish outside resilience/io.py -- route these through "
+        "resilience.io.atomic_write/atomic_publish:\n{}".format(
+            "\n".join(f.format() for f in report.new)))
+    # The rule itself still rejects the original violation if
+    # reintroduced anywhere in the package.
+    findings, _ = analysis.analyze_source(
+        "import os\nos.replace('tmp', 'dst')\n", "lddl_tpu/balance/x.py",
+        analysis.get_rules(["atomic-publish"]))
+    assert [f.rule for f in findings] == ["atomic-publish"]

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Fast static gate: the determinism/SPMD-safety analyzer plus a
+# whole-tree syntax pass (pyflakes when available, compileall otherwise).
+# Wired into tier-1 via tests/test_analysis.py::test_ci_check_script.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+python -m tools.lddl_check "$@"
+
+if python -c "import pyflakes" >/dev/null 2>&1; then
+    python -m pyflakes lddl_tpu tools benchmarks
+else
+    python -m compileall -q lddl_tpu tools benchmarks
+fi
+echo "ci_check: OK"

@@ -1,0 +1,112 @@
+"""lddl-check: run the determinism / SPMD-safety analyzer over the tree.
+
+Usage::
+
+    python -m tools.lddl_check                      # lddl_tpu tools benchmarks
+    python -m tools.lddl_check lddl_tpu --json      # machine-readable
+    python -m tools.lddl_check --list-rules
+    python -m tools.lddl_check --write-baseline     # regenerate grandfather
+                                                    # file (then fill in the
+                                                    # "reason" fields!)
+
+Exit status: 0 when every finding is baselined or inline-suppressed,
+1 when new findings (or syntax errors) exist, 2 on usage errors.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))  # repo root, for direct execution
+
+from lddl_tpu import analysis  # noqa: E402
+
+DEFAULT_PATHS = ("lddl_tpu", "tools", "benchmarks")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="lddl_check",
+        description="AST-based determinism & SPMD-safety analyzer")
+    parser.add_argument("paths", nargs="*", default=list(DEFAULT_PATHS),
+                        help="files or directories (repo-relative); "
+                             "default: %(default)s")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON report instead of text")
+    parser.add_argument("--baseline",
+                        default=os.path.join(analysis.REPO_ROOT,
+                                             analysis.DEFAULT_BASELINE),
+                        help="baseline file (empty string disables)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from current findings "
+                             "(reasons for pre-existing entries are kept)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run (default all)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in analysis.all_rules():
+            print("{:22s} {}".format(rule.id, rule.doc))
+        return 0
+
+    try:
+        rules = analysis.get_rules(
+            [r.strip() for r in args.rules.split(",")] if args.rules
+            else None)
+    except ValueError as e:
+        parser.error(str(e))
+
+    if args.write_baseline and (args.rules
+                                or sorted(args.paths)
+                                != sorted(DEFAULT_PATHS)):
+        # A filtered run sees only a subset of findings; rewriting the
+        # baseline from it would silently drop every grandfathered entry
+        # outside the filter.
+        parser.error("--write-baseline requires a full run: drop --rules "
+                     "and explicit paths")
+
+    try:
+        report = analysis.run_check(args.paths, rules=rules,
+                                    baseline_path=args.baseline or "")
+    except FileNotFoundError as e:
+        parser.error(str(e))
+
+    if args.write_baseline:
+        old = {(e.get("rule"), e.get("path"), e.get("match")):
+               e.get("reason", "") for e in
+               analysis.load_baseline(args.baseline)}
+        entries = []
+        for f in report.new + report.baselined:
+            entry = analysis.baseline_entry(
+                f, old.get(f.key(), "TODO: justify or fix"))
+            if entry not in entries:
+                entries.append(entry)
+        entries.sort(key=lambda e: (e["path"], e["rule"], e["match"]))
+        with open(args.baseline, "w", encoding="utf-8") as fh:
+            json.dump({"entries": entries}, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+        print("wrote {} baseline entr{} to {}".format(
+            len(entries), "y" if len(entries) == 1 else "ies",
+            args.baseline))
+        return 0
+
+    if args.json:
+        json.dump(report.to_dict(), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in report.new:
+            print(f.format())
+        for path, msg in report.errors:
+            print("{}:1: [parse-error] {}".format(path, msg))
+        print("lddl-check: {} file(s), {} new finding(s), {} baselined, "
+              "{} suppressed".format(report.files, len(report.new),
+                                     len(report.baselined),
+                                     len(report.suppressed)))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
