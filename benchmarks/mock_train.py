@@ -113,22 +113,31 @@ def attach_args():
 
 def _debug_print(loader, tokenizer):
     from lddl_tpu.utils.fs import deserialize_np_array
+
+    def toks(v):
+        # v1 raw samples carry space-joined token strings; schema-v2
+        # carries int32 id arrays — render both as token lists.
+        if isinstance(v, str):
+            return v.split()
+        return tokenizer.convert_ids_to_tokens([int(i) for i in v])
+
     for i, batch in enumerate(loader):
         for sample in batch[:2]:
             if len(sample) == 5:
                 a, b, rn, pos_b, labels = sample
-                seq = (["[CLS]"] + a.split() + ["[SEP]"] + b.split()
-                       + ["[SEP]"])
-                pos = deserialize_np_array(pos_b).tolist()
-                labs = labels.split()
+                seq = ["[CLS]"] + toks(a) + ["[SEP]"] + toks(b) + ["[SEP]"]
+                pos = (deserialize_np_array(pos_b)
+                       if isinstance(pos_b, (bytes, bytearray)) else pos_b)
+                labs = toks(labels)
                 print("is_random_next:", rn)
                 print("masked:", " ".join(seq))
                 for p, l in zip(pos, labs):
-                    seq[p] = l
+                    seq[int(p)] = l
                 print("demasked:", " ".join(seq))
             else:
                 print("is_random_next:", sample[2])
-                print("[CLS] {} [SEP] {} [SEP]".format(sample[0], sample[1]))
+                print("[CLS] {} [SEP] {} [SEP]".format(
+                    " ".join(toks(sample[0])), " ".join(toks(sample[1]))))
         if i >= 2:
             return
 
@@ -164,9 +173,38 @@ def _telemetry_report(obs):
         print("telemetry: wrote {}".format(path))
 
 
+def _warm_parquet_reader():
+    """The first pyarrow.parquet use in a process pays ~0.4 s of lazy
+    imports and IO-thread-pool spin-up; pay it on a throwaway in-memory
+    table BEFORE the timed loop so the 'sustained' meter measures the
+    loader pipeline, not pyarrow's one-time init (which, on small bench
+    corpora, dominated epoch 0 and diluted every config equally)."""
+    import io
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    buf = io.BytesIO()
+    pq.write_table(pa.table({"x": [0]}), buf)
+    buf.seek(0)
+    pq.read_table(buf)
+
+
+def _queue_cost(loader):
+    """(bytes, batches) shipped over process-worker queues, summed over
+    the wrapped DataLoaders (Binned holds one per bin; packed mode wraps
+    an inner raw-sample loader). Zero in thread mode."""
+    dls = [loader]
+    if getattr(loader, "_dataloaders", None) is not None:
+        dls = loader._dataloaders
+    elif getattr(loader, "_inner", None) is not None:
+        dls = [loader._inner]
+    return (sum(getattr(d, "queue_bytes", 0) for d in dls),
+            sum(getattr(d, "queue_batches", 0) for d in dls))
+
+
 def main():
     args = attach_args().parse_args()
-    from lddl_tpu.loader import get_bert_pretrain_data_loader, to_device_batch
+    from lddl_tpu.loader import (get_bert_pretrain_data_loader,
+                                 prefetch_to_device, to_device_batch)
     # The observability hooks are inert no-ops unless armed, so no
     # conditional plumbing: configure() is the only gated call.
     from lddl_tpu import observability as obs
@@ -272,11 +310,14 @@ def main():
             step_fn = make_sharded_train_step(mesh, cfg)
 
         def step(batch):
+            # Batches arrive already device-resident and mesh-sharded via
+            # prefetch_to_device (host collate + H2D overlap the previous
+            # step instead of serializing with it).
             nonlocal state
-            state, metrics = step_fn(state, to_device_batch(batch, mesh),
-                                     seed=args.seed)
+            state, metrics = step_fn(state, batch, seed=args.seed)
             return metrics
 
+    _warm_parquet_reader()
     batch_time = AverageMeter(warmup=2)
     throughput = AverageMeter(warmup=2)
     seq_len_hist = Histogram()
@@ -286,15 +327,33 @@ def main():
     total_samples = 0
     total_wall = 0.0
 
+    batches = loader
+    if step is not None:
+        # Double-buffered device prefetch: the next batch's collate and
+        # H2D transfer overlap with the current train step. The per-batch
+        # length stats ride along PRECOMPUTED ON THE HOST (inside the
+        # prefetch thread) — summing the device copy in the consumer
+        # would force a host-device sync before every step dispatch and
+        # re-serialize exactly the overlap being measured.
+        batches = prefetch_to_device(
+            loader,
+            device_put=lambda b: (b["attention_mask"].sum(axis=1),
+                                  to_device_batch(b, mesh)))
+
     with obs.span("mock_train.run", epochs=args.epochs,
                   batch_size=args.batch_size):
         for epoch in range(args.start_epoch, args.start_epoch + args.epochs):
             epoch_t0 = time.perf_counter()
             epoch_samples = 0
             t0 = time.perf_counter()
-            for i, batch in enumerate(loader):
+            for i, batch in enumerate(batches):
+                if step is not None:
+                    lens, batch = batch  # host stats + device batch
+                else:
+                    lens = batch["attention_mask"].sum(axis=1)
                 n, L = batch["input_ids"].shape
-                # Shape contracts (ref torch_train.py:171-175).
+                # Shape contracts (ref torch_train.py:171-175) — shape is
+                # metadata, so these never sync a device batch.
                 assert batch["attention_mask"].shape == (n, L)
                 assert batch["labels"].shape == (n, L)
                 if args.family == "bart":
@@ -302,7 +361,6 @@ def main():
                 else:
                     assert batch["token_type_ids"].shape == (n, L)
                     assert batch["next_sentence_labels"].shape == (n,)
-                lens = batch["attention_mask"].sum(axis=1)
                 seq_len_hist.update(L, n)
                 pad_hist.update(L, int((L - lens).sum()))
                 all_min_lens.append(int(lens.min()))
@@ -339,6 +397,10 @@ def main():
             step_time.avg * 1e3, dict(mesh.shape)))
     print("padded-zero ratio: {:.4f} ({} pad / {} slots)".format(
         total_pad / max(total_tokens, 1), total_pad, total_tokens))
+    qbytes, qbatches = _queue_cost(loader)
+    if qbatches:
+        print("loader queue: {:.0f} bytes/batch over {} batches".format(
+            qbytes / qbatches, qbatches))
     if args.seq_len_dir:
         os.makedirs(args.seq_len_dir, exist_ok=True)
         np.savez(

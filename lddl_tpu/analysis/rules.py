@@ -438,3 +438,52 @@ class ManifestDeterminismRule(Rule):
                         "content is compared byte-for-byte across runs "
                         "and ranks on resume; nondeterministic fields "
                         "poison it".format(name, node.name))
+
+
+# ------------------------------------------------------------ python-hot-loop
+
+# Methods that materialize per-element Python objects out of Arrow/numpy
+# containers. On the loader's per-sample path each call site multiplies by
+# tokens-per-epoch; the schema-v2 columnar decode exists precisely so none
+# of these run per token.
+_PY_MATERIALIZERS = frozenset({"as_py", "to_pylist", "to_pydict", "tolist"})
+
+
+@register
+class PythonHotLoopRule(Rule):
+    id = "python-hot-loop"
+    doc = ("no per-token Python iteration on the loader hot path "
+           "(.as_py()/.to_pylist()/.to_pydict()/.tolist(), nested-"
+           "generator np.fromiter over token streams) — decode/collate "
+           "stay columnar; justified schema-v1 fallbacks are baselined")
+    only = ("lddl_tpu/loader/*",)
+
+    def run(self, ctx):
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _PY_MATERIALIZERS:
+                yield ctx.finding(
+                    self.id, node,
+                    ".{}() materializes one Python object per element; on "
+                    "the loader hot path that is per-token work every "
+                    "epoch — decode Arrow list<int32> columns to numpy "
+                    "views (loader.bert._list_views) or move the work "
+                    "offline to preprocess (schema v2); suppress with a "
+                    "justification for v1-fallback or error-path use"
+                    .format(func.attr))
+                continue
+            name = ctx.resolve_call(node)
+            if name == "numpy.fromiter" and node.args:
+                gen = node.args[0]
+                if isinstance(gen, ast.GeneratorExp) \
+                        and len(gen.generators) > 1:
+                    yield ctx.finding(
+                        self.id, node,
+                        "np.fromiter over a nested generator iterates per "
+                        "TOKEN in Python (outer per-sample, inner per-"
+                        "element); consume schema-v2 id columns or batch "
+                        "the conversion — baseline only the schema-v1 "
+                        "text fallback")

@@ -15,6 +15,14 @@ def attach_args(parser=None):
     attach_corpus_args(parser)
     attach_multihost_arg(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True)
+    parser.add_argument("--vocab-file", default=None,
+                        help="emit schema-v2 token-id columns "
+                             "(sentence_ids/sentence_lens) tokenized with "
+                             "this vocab; the loader must use the same "
+                             "vocab (default: text-only v1 shards)")
+    parser.add_argument("--tokenizer", default=None,
+                        help="HF hub tokenizer name (alternative to "
+                             "--vocab-file) for schema-v2 shards")
     parser.add_argument("--target-seq-length", type=int, default=128)
     parser.add_argument("--short-seq-prob", type=float, default=0.1)
     parser.add_argument("--sample-ratio", type=float, default=0.9)
@@ -42,6 +50,11 @@ def main(args=None):
     import os
     args = args if args is not None else attach_args().parse_args()
     comm = communicator_of(args)
+    tokenizer = None
+    if args.vocab_file or args.tokenizer:
+        from ..preprocess import get_tokenizer
+        tokenizer = get_tokenizer(vocab_file=args.vocab_file,
+                                  pretrained_model_name=args.tokenizer)
     run_bart_preprocess(
         corpus_paths_of(args),
         args.sink,
@@ -60,6 +73,7 @@ def main(args=None):
         log=print,
         spool_groups=args.spool_groups,
         resume=args.resume,
+        tokenizer=tokenizer,
     )
 
 

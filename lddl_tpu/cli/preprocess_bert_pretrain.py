@@ -56,6 +56,11 @@ def attach_args(parser=None):
                              "train time only)")
     parser.add_argument("--output-format", choices=("parquet", "txt"),
                         default="parquet")
+    parser.add_argument("--schema-version", type=int, choices=(1, 2),
+                        default=2,
+                        help="parquet shard schema: 2 (default) adds the "
+                             "token-id list columns the loader decodes "
+                             "zero-copy; 1 = original text-only shards")
     attach_bool_arg(parser, "resume", default=False,
                     help_str="continue a crashed/failed run from its unit "
                              "ledger (skips completed spool groups)")
@@ -82,6 +87,7 @@ def main(args=None):
         engine=args.engine,
         tokenizer_engine=args.tokenizer_engine,
         splitter=args.splitter,
+        schema_version=args.schema_version,
     )
     import os
     run_bert_preprocess(

@@ -1,5 +1,5 @@
 from .datasets import ShuffleBuffer, ParquetDataset
-from .dataloader import DataLoader, Binned
+from .dataloader import DataLoader, Binned, prefetch_to_device
 from .bert import (get_bert_pretrain_data_loader, BertPretrainBinned,
                    BertPackedCollate, PackedBertLoader)
 from .bart import get_bart_pretrain_data_loader, BartCollate
@@ -18,6 +18,7 @@ __all__ = [
     "BertPackedCollate",
     "PackedBertLoader",
     "dp_info_of_process",
+    "prefetch_to_device",
     "process_dp_info",
     "to_device_batch",
     "to_device_step_batches",

@@ -32,7 +32,27 @@ from .datasets import (ParquetDataset, annotate_quarantine,
 
 
 def decode_record_batch(b):
-    for s in b.column("sentences").to_pylist():
+    """Schema-v2 BART shards (``sentence_ids`` present) decode to
+    ``(flat_ids, sent_lens)`` int32 ndarray-view pairs — the precomputed
+    per-sentence tokenization the collate otherwise derives from the chunk
+    text every epoch. Schema-v1 decodes to the original chunk strings;
+    selection is per shard."""
+    from .. import observability as obs
+    from .bert import _list_views
+    names = b.schema.names
+    if "sentence_ids" in names:
+        if obs.enabled():
+            obs.inc("loader_decode_columnar_batches_total")
+        flat, off = _list_views(b.column("sentence_ids"))
+        lens_v, lens_off = _list_views(b.column("sentence_lens"))
+        for i in range(len(off) - 1):
+            yield (flat[off[i]:off[i + 1]],
+                   lens_v[lens_off[i]:lens_off[i + 1]])
+        return
+    if obs.enabled():
+        obs.inc("loader_decode_legacy_batches_total")
+    # Legacy v1 text path: per-row Python strings are the shard format.
+    for s in b.column("sentences").to_pylist():  # lddl: disable=python-hot-loop
         yield s
 
 
@@ -111,26 +131,42 @@ class BartCollate:
         tok = self._tokenizer
         limit = self._max_seq_length - 2
 
-        # Tokenize each sentence separately (one batched call across the
-        # whole batch), so sentence permutation happens in TOKEN space on
+        # Per-sample per-sentence token-id lists. Schema-v2 samples carry
+        # them precomputed ((flat_ids, sent_lens) ndarray views, sliced
+        # here with numpy only); v1 chunk strings are sentence-split and
+        # tokenized (one batched call across the whole batch), every
+        # epoch. Sentence permutation then happens in TOKEN space on
         # exactly the clean window: truncate first, then permute/infill —
         # encoder input and labels always cover the same tokens.
-        per_sample_sentences = [split_sentences(c) for c in samples]
-        flat = [s for sents in per_sample_sentences for s in sents]
-        enc = tok(flat, add_special_tokens=False,
-                  return_attention_mask=False)["input_ids"] if flat else []
+        per_sample_enc = [None] * len(samples)
+        strings = []
+        for i, c in enumerate(samples):
+            if isinstance(c, str):
+                strings.append(i)
+                continue
+            flat_ids, sent_lens = c
+            ends = np.cumsum(sent_lens)
+            per_sample_enc[i] = [flat_ids[e - l:e]
+                                 for l, e in zip(sent_lens, ends)]
+        if strings:
+            per_sent = [split_sentences(samples[i]) for i in strings]
+            flat = [s for sents in per_sent for s in sents]
+            enc = tok(flat, add_special_tokens=False,
+                      return_attention_mask=False)["input_ids"] if flat \
+                else []
+            k = 0
+            for i, sents in zip(strings, per_sent):
+                per_sample_enc[i] = enc[k:k + len(sents)]
+                k += len(sents)
         clean, noisy = [], []
-        k = 0
-        for sents in per_sample_sentences:
-            sample_enc = enc[k:k + len(sents)]
-            k += len(sents)
+        for sample_enc in per_sample_enc:
             sent_ids = []
             budget = limit
             for ids in sample_enc:
                 if budget <= 0:
                     break
                 ids = ids[:budget]
-                if ids:
+                if len(ids):
                     sent_ids.append(ids)
                     budget -= len(ids)
             clean.append([i for s in sent_ids for i in s])

@@ -29,12 +29,60 @@ from .datasets import (ParquetDataset, annotate_quarantine,
                        verified_shard_paths)
 
 
+def _list_views(col):
+    """(values, offsets) numpy views of an Arrow ``list<int32>`` column —
+    the values buffer is referenced zero-copy, so per-row slices are views
+    into the shard's decoded Arrow memory, never per-row Python objects."""
+    lens = col.value_lengths().to_numpy(zero_copy_only=False)
+    values = col.flatten().to_numpy(zero_copy_only=True)
+    offsets = np.zeros(len(lens) + 1, dtype=np.int64)
+    np.cumsum(lens, out=offsets[1:])
+    return values, offsets
+
+
+def _decode_columnar(b, names):
+    """Schema-v2 fast path: one zero-copy buffer grab per column, then
+    per-row ndarray views (no string materialization, no per-token work —
+    the collate consumes the id views directly)."""
+    flat_a, off_a = _list_views(b.column("A_ids"))
+    flat_b, off_b = _list_views(b.column("B_ids"))
+    rn = b.column("is_random_next").to_numpy(zero_copy_only=False)
+    n = len(rn)
+    if "masked_lm_positions_ids" in names:
+        pos_v, pos_off = _list_views(b.column("masked_lm_positions_ids"))
+        lab_v, lab_off = _list_views(b.column("masked_lm_label_ids"))
+        for i in range(n):
+            yield (flat_a[off_a[i]:off_a[i + 1]],
+                   flat_b[off_b[i]:off_b[i + 1]], rn[i],
+                   pos_v[pos_off[i]:pos_off[i + 1]],
+                   lab_v[lab_off[i]:lab_off[i + 1]])
+    else:
+        for i in range(n):
+            yield (flat_a[off_a[i]:off_a[i + 1]],
+                   flat_b[off_b[i]:off_b[i + 1]], rn[i])
+
+
 def decode_record_batch(b):
     """Yield sample tuples from a parquet RecordBatch:
-    (A, B, is_random_next[, masked_lm_positions, masked_lm_labels])."""
-    columns = set(b.schema.names)
-    static = "masked_lm_positions" in columns
-    b = b.to_pydict()
+    (A, B, is_random_next[, masked_lm_positions, masked_lm_labels]).
+
+    Schema v2 shards (``A_ids`` present) decode to int32 ndarray views
+    over the batch's flat token-id buffers; schema v1 decodes to the
+    original Python strings. Selection is per-shard, so directories mixing
+    both schemas stream correctly (and byte-identically — the collate
+    normalizes)."""
+    from .. import observability as obs
+    names = b.schema.names
+    if "A_ids" in names:
+        if obs.enabled():
+            obs.inc("loader_decode_columnar_batches_total")
+        yield from _decode_columnar(b, names)
+        return
+    if obs.enabled():
+        obs.inc("loader_decode_legacy_batches_total")
+    static = "masked_lm_positions" in names
+    # Legacy v1 text path: per-row Python strings are the shard format.
+    b = b.to_pydict()  # lddl: disable=python-hot-loop
     if static:
         for row in zip(b["A"], b["B"], b["is_random_next"],
                        b["masked_lm_positions"], b["masked_lm_labels"]):
@@ -88,17 +136,54 @@ class BertCollate:
         from ..ops.packing import round_up
         return round_up(longest, self._align)
 
-    def _token_ids_and_lens(self, texts):
-        """One flat id array + per-text lengths for a list of space-joined
-        token strings (single pass, dict lookups only)."""
-        token_lists = [t.split() for t in texts]
-        lens = np.fromiter((len(t) for t in token_lists), dtype=np.int64,
-                           count=len(token_lists))
+    def _token_ids_and_lens(self, seqs):
+        """One flat id array + per-item lengths. Items are int32 token-id
+        ndarrays (schema-v2 shards: used as-is, zero per-token work) or
+        space-joined token strings (schema-v1: split + vocab lookup),
+        freely mixed when a directory holds shards of both schemas."""
+        n_str = sum(isinstance(s, str) for s in seqs)
+        if n_str == 0:
+            # Columnar fast path: lengths off the views, ONE C-level
+            # concatenation for the flat batch buffer.
+            lens = np.fromiter(map(len, seqs), dtype=np.int64,
+                               count=len(seqs))
+            flat = (np.concatenate(seqs) if len(seqs)
+                    else np.zeros(0, dtype=np.int32))
+            return np.ascontiguousarray(flat, dtype=np.int32), lens
         vocab_get = self._vocab.get
         unk = self._unk_id
-        flat = np.fromiter(
-            (vocab_get(t, unk) for ts in token_lists for t in ts),
-            dtype=np.int32, count=int(lens.sum()))
+        if n_str == len(seqs):
+            # Pure v1: single bulk pass, dict lookups only. Per-token
+            # Python iteration is inherent to the text schema (baselined).
+            token_lists = [t.split() for t in seqs]
+            lens = np.fromiter((len(t) for t in token_lists),
+                               dtype=np.int64, count=len(token_lists))
+            flat = np.fromiter(
+                (vocab_get(t, unk) for ts in token_lists for t in ts),
+                dtype=np.int32, count=int(lens.sum()))
+            return flat, lens
+        # Mixed v1/v2 batch (shards of both schemas in one directory):
+        # normalize the strings, then concatenate like the fast path.
+        arrs = [s if not isinstance(s, str) else
+                np.fromiter((vocab_get(t, unk) for t in s.split()),
+                            dtype=np.int32)
+                for s in seqs]
+        lens = np.fromiter(map(len, arrs), dtype=np.int64, count=len(arrs))
+        return np.concatenate(arrs).astype(np.int32, copy=False), lens
+
+    @staticmethod
+    def _positions_and_lens(samples):
+        """Flat masked-lm positions + per-sample counts, as ONE batched
+        decode: schema-v2 rows carry int32 ndarray views (already sliced
+        from one Arrow buffer), schema-v1 rows carry serialize_np_array
+        bytes (decoded per row — the v1 format is row-serialized)."""
+        pos_list = [s[3] if not isinstance(s[3], (bytes, bytearray))
+                    else deserialize_np_array(s[3])
+                    for s in samples]
+        lens = np.fromiter(map(len, pos_list), dtype=np.int64,
+                           count=len(pos_list))
+        flat = (np.concatenate(pos_list).astype(np.int64, copy=False)
+                if pos_list else np.zeros(0, dtype=np.int64))
         return flat, lens
 
     @staticmethod
@@ -140,19 +225,16 @@ class BertCollate:
 
         labels = np.full((n, seq_len), self._ignore_index, dtype=np.int32)
         if static:
-            pos_list = [deserialize_np_array(s[3]).astype(np.int64)
-                        for s in samples]
+            flat_pos, pos_lens = self._positions_and_lens(samples)
             flat_labels, lens_m = self._token_ids_and_lens(
                 [s[4] for s in samples])
-            pos_lens = np.fromiter(map(len, pos_list), dtype=np.int64,
-                                   count=n)
             if not np.array_equal(pos_lens, lens_m):
                 raise ValueError(
                     "masked_lm_positions/masked_lm_labels length mismatch "
                     "in sample(s) {}".format(
+                        # error path only -- lddl: disable=python-hot-loop
                         np.flatnonzero(pos_lens != lens_m).tolist()))
-            labels[np.repeat(rows, lens_m),
-                   np.concatenate(pos_list)] = flat_labels
+            labels[np.repeat(rows, lens_m), flat_pos] = flat_labels
         else:
             if g is None:
                 raise ValueError("dynamic masking needs a worker RNG")
@@ -258,12 +340,10 @@ class BertPackedCollate(BertCollate):
 
         labels = np.full((R, L), self._ignore_index, dtype=np.int32)
         if static:
-            pos_list = [deserialize_np_array(s[3]).astype(np.int64)
-                        for s in samples]
+            flat_pos, _ = self._positions_and_lens(samples)
             flat_labels, lens_m = self._token_ids_and_lens(
                 [s[4] for s in samples])
-            labels.flat[np.repeat(base, lens_m)
-                        + np.concatenate(pos_list)] = flat_labels
+            labels.flat[np.repeat(base, lens_m) + flat_pos] = flat_labels
         else:
             if g is None:
                 raise ValueError("dynamic masking needs a worker RNG")
@@ -382,8 +462,13 @@ class PackedBertLoader:
                                   1.0 - self.pad_ratio)
                 yield batch
 
+        def seg_len(v):
+            # v2 samples carry id ndarrays (len = token count directly);
+            # v1 carries space-joined token strings.
+            return len(v) if not isinstance(v, str) else len(v.split())
+
         def sample_len(s):
-            return len(s[0].split()) + len(s[1].split()) + 3
+            return seg_len(s[0]) + seg_len(s[1]) + 3
 
         try:
             for raw_batch in inner_it:

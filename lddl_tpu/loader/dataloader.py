@@ -26,38 +26,57 @@ from ..utils import rng as lrng
 from ..utils.logging import DatasetLogger
 
 
-def _observe_batch(batch, dt_s):
-    """Per-batch telemetry: latency histogram plus the paper's headline
-    quantity — padding efficiency = real tokens / padded slots, read off
-    the attention mask (counters accumulate the epoch totals; the gauge
-    holds the cumulative ratio). Read-only on the batch; called only when
-    telemetry is enabled."""
-    obs.observe("loader_batch_latency_seconds", dt_s)
-    obs.inc("loader_batches_total")
-    if isinstance(batch, dict) and "attention_mask" in batch:
-        mask = batch["attention_mask"]
-        real = int(mask.sum())
-        obs.inc("loader_samples_total", len(mask))
-        obs.inc("loader_real_tokens_total", real)
-        obs.inc("loader_padded_slots_total", int(mask.size))
+class _EpochObserver:
+    """Per-batch telemetry with the registry handles resolved ONCE per
+    epoch (each ``obs.inc(name)`` is a registry dict lookup under a lock;
+    at tens of thousands of batches/s that was the telemetry hot-path
+    cost): the per-batch work is cached-handle increments only, and the
+    padding-efficiency gauge (real tokens / padded slots, the paper's
+    headline quantity) is folded into the end-of-epoch summary instead of
+    being recomputed per batch. Read-only on the batch; constructed only
+    when telemetry is enabled."""
+
+    __slots__ = ("_latency", "_batches", "_samples", "_real", "_padded",
+                 "_gauge")
+
+    def __init__(self):
         reg = obs.registry()
-        padded = reg.counter("loader_padded_slots_total").total()
+        self._latency = reg.histogram("loader_batch_latency_seconds")
+        self._batches = reg.counter("loader_batches_total")
+        self._samples = reg.counter("loader_samples_total")
+        self._real = reg.counter("loader_real_tokens_total")
+        self._padded = reg.counter("loader_padded_slots_total")
+        self._gauge = reg.gauge("loader_padding_efficiency")
+
+    def batch(self, batch, dt_s):
+        self._latency.observe(dt_s)
+        self._batches.inc()
+        if isinstance(batch, dict) and "attention_mask" in batch:
+            mask = batch["attention_mask"]
+            self._samples.inc(len(mask))
+            self._real.inc(int(mask.sum()))
+            self._padded.inc(int(mask.size))
+        elif isinstance(batch, (list, tuple)):
+            self._samples.inc(len(batch))
+
+    def finish(self):
+        """End-of-epoch gauge update from the process-cumulative totals —
+        the same value the per-batch recomputation converged to."""
+        padded = self._padded.total()
         if padded:
-            reg.gauge("loader_padding_efficiency").set(
-                reg.counter("loader_real_tokens_total").total() / padded)
-    elif isinstance(batch, (list, tuple)):
-        obs.inc("loader_samples_total", len(batch))
+            self._gauge.set(self._real.total() / padded)
 
 
 def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
                       rng_spec, out_q):
     """Stream one epoch's collated batches into the queue.
 
-    Batches are pickled HERE (bytes on the queue), not by mp.Queue's
-    feeder thread: a feeder-thread pickling error would silently drop the
-    batch and still deliver a clean 'end' — pickling in this try block
-    turns it into a forwarded error instead."""
-    import pickle
+    Batches are serialized HERE (one framed bytes payload per batch via
+    qserde: pickle protocol 5 with out-of-band numpy buffers), not by
+    mp.Queue's feeder thread — a feeder-thread pickling error would
+    silently drop the batch and still deliver a clean 'end'; serializing
+    in this try block turns it into a forwarded error instead."""
+    from . import qserde
 
     try:
         if rng_spec is not None:
@@ -71,7 +90,7 @@ def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
             # worker here, before the batch is enqueued (supervision in
             # DataLoader._iter_process restarts + replays it).
             faults.fault_point("worker", "w{}".format(worker_idx))
-            out_q.put(("batch", pickle.dumps(collate(b), protocol=-1)))
+            out_q.put(("batch", qserde.encode(collate(b))))
 
         batch = []
         for sample in dataset.worker_stream(epoch, worker_idx):
@@ -131,6 +150,11 @@ class DataLoader:
         self._finalizer = None
         self._pool_gen = 0
         self._epoch_active = False
+        # Cumulative process-mode IPC cost: framed qserde bytes and
+        # batches received over this loader's lifetime (benchmarks read
+        # these to report pickle-bytes/batch; always 0 in thread mode).
+        self.queue_bytes = 0
+        self.queue_batches = 0
 
     @staticmethod
     def _check_process_mode(dataset):
@@ -397,7 +421,7 @@ class DataLoader:
         skip[w] = served[w]
 
     def _iter_process(self):
-        import pickle
+        from . import qserde
         ds = self.dataset
         epoch = ds.advance_epoch()
         rng = getattr(self._collate_fn, "needs_rng", False)
@@ -474,7 +498,9 @@ class DataLoader:
                         live.remove(w)
                         continue
                     served[w] += 1
-                    yield pickle.loads(payload)
+                    self.queue_bytes += len(payload)
+                    self.queue_batches += 1
+                    yield qserde.decode(payload)
         finally:
             if live:
                 # Failed or abandoned mid-epoch: workers are mid-stream
@@ -503,13 +529,18 @@ class DataLoader:
         Wall time between consumer next() calls is the batch latency the
         training loop actually experiences (prefetch included)."""
         import time
-        with obs.span("loader.epoch", mode=self._worker_mode,
-                      batch_size=self.batch_size):
-            t0 = time.perf_counter()
-            for batch in inner:
-                _observe_batch(batch, time.perf_counter() - t0)
-                yield batch
+        watcher = _EpochObserver()
+        try:
+            with obs.span("loader.epoch", mode=self._worker_mode,
+                          batch_size=self.batch_size):
                 t0 = time.perf_counter()
+                for batch in inner:
+                    watcher.batch(batch, time.perf_counter() - t0)
+                    yield batch
+                    t0 = time.perf_counter()
+        finally:
+            # Abandoned epochs still summarize what they served.
+            watcher.finish()
 
     def _iter_thread(self):
         streams = self.dataset.start_epoch()
@@ -538,6 +569,92 @@ class DataLoader:
             stop.set()
             for t in threads:
                 t.join(timeout=5)
+
+
+class _DevicePrefetcher:
+    """Iterable produced by :func:`prefetch_to_device` (re-iterable: each
+    ``iter()`` runs one epoch of the wrapped loader, like DataLoader)."""
+
+    def __init__(self, loader, device_put, depth):
+        self._loader = loader
+        self._device_put = device_put
+        self._depth = depth
+
+    def __len__(self):
+        return len(self._loader)
+
+    def __iter__(self):
+        stop = threading.Event()
+        q = queue.Queue(maxsize=self._depth)
+
+        def put(item):
+            # Stop-aware bounded put (terminal markers included): an
+            # abandoned consumer must never leave this thread blocked on
+            # a full queue forever.
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self._loader:
+                    # device_put dispatches the H2D transfer
+                    # asynchronously; the consumer's current step overlaps
+                    # with the NEXT batch's host collate + transfer.
+                    if not put(("batch", self._device_put(batch))):
+                        return
+                put(("end", None))
+            except BaseException as e:  # noqa: BLE001 - forwarded
+                put(("error", e))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        obs_on = obs.enabled()
+        if obs_on:
+            reg = obs.registry()
+            batches = reg.counter("loader_prefetch_batches_total")
+            wait = reg.histogram("loader_prefetch_wait_seconds")
+        try:
+            import time
+            while True:
+                t0 = time.perf_counter() if obs_on else 0.0
+                kind, payload = q.get()
+                if kind == "error":
+                    raise payload
+                if kind == "end":
+                    return
+                if obs_on:
+                    batches.inc()
+                    wait.observe(time.perf_counter() - t0)
+                yield payload
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+
+def prefetch_to_device(loader, device_put=None, depth=2):
+    """Double-buffered host->device pipeline: a background thread drains
+    ``loader`` and runs ``device_put`` (default ``jax.device_put``, which
+    dispatches transfers asynchronously) up to ``depth`` batches ahead of
+    the consumer, so host collate + H2D transfer overlap with the running
+    train step instead of serializing with it.
+
+    Pass ``device_put=lambda b: to_device_batch(b, mesh)`` to land
+    globally-sharded batches on a device mesh (benchmarks/mock_train.py
+    --with-model does). The wrapper is re-iterable — each ``iter()``
+    advances the wrapped loader one epoch — and order-preserving, so the
+    determinism contract is untouched. Telemetry (when armed):
+    ``loader_prefetch_batches_total`` and ``loader_prefetch_wait_seconds``
+    (time the consumer actually blocked on the queue — near zero when the
+    pipeline keeps up)."""
+    if device_put is None:
+        import jax
+        device_put = jax.device_put
+    return _DevicePrefetcher(loader, device_put, max(1, depth))
 
 
 class Binned:

@@ -79,6 +79,29 @@ def joined_token_strings(flat_ids, row_lens, table):
         pa.utf8(), n, [None, pa.py_buffer(offsets), pa.py_buffer(data)])
 
 
+def int32_list_array(flat_vals, row_lens):
+    """``list<int32>`` ListArray: row i = its slice of ``flat_vals``
+    (row-major, ``row_lens[i]`` values per row) — the schema-v2 token-id
+    columns, assembled from the SAME flat-id + offsets buffers the string
+    builders consume, so emitting them is one extra buffer handoff, not a
+    second materialization pass."""
+    row_lens = np.asarray(row_lens, dtype=np.int64)
+    n = len(row_lens)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(row_lens, out=offsets[1:])
+    if offsets[-1] >= 1 << 31:
+        raise ValueError(
+            "column exceeds 2^31 values in one bucket; raise --num-blocks "
+            "so buckets shrink")
+    offsets = offsets.astype(np.int32)
+    values = np.ascontiguousarray(np.asarray(flat_vals, dtype=np.int32))
+    child = pa.Array.from_buffers(pa.int32(), len(values),
+                                  [None, pa.py_buffer(values)])
+    return pa.Array.from_buffers(pa.list_(pa.int32()), n,
+                                 [None, pa.py_buffer(offsets)],
+                                 children=[child])
+
+
 _U16_HEADER = np.frombuffer(b"R<u2", dtype=np.uint8)
 
 

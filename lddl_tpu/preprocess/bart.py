@@ -70,24 +70,63 @@ def chunks_from_text(text, config, g, splitter_params=None):
 
 class BartBucketProcessor:
     """Picklable per-bucket BART pipeline stage (pool-friendly; see
-    runner.BertBucketProcessor)."""
+    runner.BertBucketProcessor). With a ``tokenizer`` the parquet sink
+    emits schema v2: ``sentence_ids``/``sentence_lens`` list<int32>
+    columns precomputing EXACTLY what the BART loader's collate derives
+    from the chunk text every epoch (``split_sentences`` + batched
+    tokenize), so load-time noising starts from stored ids instead of
+    re-tokenizing (byte-identical batches, tests/test_schema_v2.py)."""
 
     def __init__(self, config, seed, out_dir, output_format,
-                 splitter_params=None):
+                 splitter_params=None, tokenizer=None):
         self.config = config
         self.seed = seed
         self.out_dir = out_dir
         self.output_format = output_format
         self.splitter_params = splitter_params
+        self.tokenizer = tokenizer
 
     def fingerprint(self):
         """Resume-manifest digest (see BertBucketProcessor.fingerprint;
-        no vocab — BART preprocessing is tokenizer-free)."""
+        vocab enters only when a tokenizer makes the sink schema-v2 —
+        tokenizer-less runs keep the historical component list so
+        pre-existing v1 runs stay resumable across this upgrade)."""
         from .runner import processor_fingerprint, splitter_digest
-        return processor_fingerprint(type(self).__name__, self.config,
-                                     self.seed, self.output_format,
-                                     splitter_digest(self.splitter_params),
-                                     "codec=" + DEFAULT_PARQUET_COMPRESSION)
+        parts = [type(self).__name__, self.config, self.seed,
+                 self.output_format, splitter_digest(self.splitter_params)]
+        if self.tokenizer is not None:
+            parts.append("schema=" + self._schema_tag())
+        parts.append("codec=" + DEFAULT_PARQUET_COMPRESSION)
+        return processor_fingerprint(*parts)
+
+    def _schema_tag(self):
+        # ONE definition of "same vocab" across BERT and BART resume
+        # fingerprints: TokenizerInfo.vocab_digest (memoized per rebuild,
+        # folds in do_lower_case and the exact id->token snapshot).
+        from .bert import TokenizerInfo
+        return "v2:" + TokenizerInfo(self.tokenizer).vocab_digest
+
+    def _id_columns(self, rows):
+        """(sentence_ids, sentence_lens) ListArrays for the chunk strings,
+        mirroring loader.bart.BartCollate's per-epoch computation: rules
+        sentence split of the CHUNK (the collate never sees the learned
+        splitter) + one batched add_special_tokens=False tokenize."""
+        from .arrowcols import int32_list_array
+        per_chunk = [split_sentences(r) for r in rows]
+        flat = [s for sents in per_chunk for s in sents]
+        enc = (self.tokenizer(flat, add_special_tokens=False,
+                              return_attention_mask=False)["input_ids"]
+               if flat else [])
+        sent_lens = [len(e) for e in enc]
+        flat_ids = [i for e in enc for i in e]
+        k = 0
+        chunk_tokens = []
+        for sents in per_chunk:
+            chunk_tokens.append(sum(sent_lens[k:k + len(sents)]))
+            k += len(sents)
+        return (int32_list_array(flat_ids, chunk_tokens),
+                int32_list_array(sent_lens,
+                                 [len(sents) for sents in per_chunk]))
 
     def __call__(self, texts, bucket):
         g = lrng.sample_rng(self.seed, 0xBA27, bucket)
@@ -108,8 +147,15 @@ class BartBucketProcessor:
             atomic_write(path, "".join(r + "\n" for r in rows))
             return {path: len(rows)}
         path = os.path.join(self.out_dir, "part.{}.parquet".format(bucket))
-        table = pa.table({"sentences": rows},
-                         schema=pa.schema([("sentences", pa.string())]))
+        fields = [("sentences", pa.string())]
+        columns = {"sentences": rows}
+        if self.tokenizer is not None:
+            ids, lens = self._id_columns(rows)
+            columns["sentence_ids"] = ids
+            columns["sentence_lens"] = lens
+            fields += [("sentence_ids", pa.list_(pa.int32())),
+                       ("sentence_lens", pa.list_(pa.int32()))]
+        table = pa.table(columns, schema=pa.schema(fields))
         write_table_atomic(table, path,
                            compression=DEFAULT_PARQUET_COMPRESSION)
         return {path: len(rows)}
@@ -130,10 +176,14 @@ def run_bart_preprocess(
     spool_groups=None,
     resume=False,
     progress_interval=5.0,
+    tokenizer=None,
 ):
     """Run the BART preprocessing pipeline (SPMD contract per
     run_sharded_pipeline). Output: part.<k>.parquet with a single
-    ``sentences`` string column (ref: bart/pretrain.py:136-152)."""
+    ``sentences`` string column (ref: bart/pretrain.py:136-152); pass a
+    ``tokenizer`` to add the schema-v2 ``sentence_ids``/``sentence_lens``
+    token-id columns the loader consumes without re-tokenizing (the SAME
+    tokenizer must then be used at load time, as with BERT shards)."""
     config = config or BartPretrainConfig()
     if output_format not in ("parquet", "txt"):
         raise ValueError("output_format must be parquet|txt")
@@ -144,7 +194,8 @@ def run_bart_preprocess(
         corpus_paths,
         out_dir,
         BartBucketProcessor(config, seed, out_dir, output_format,
-                            splitter_params=splitter_params),
+                            splitter_params=splitter_params,
+                            tokenizer=tokenizer),
         num_blocks=num_blocks,
         sample_ratio=sample_ratio,
         seed=seed,

@@ -71,6 +71,13 @@ class BertPretrainConfig:
     # TRAIN time only; the decision runs nltk-free in Python AND in the
     # C++ engine, fuzz-pinned to parity).
     splitter: str = "rules"
+    # Shard schema: 2 (default) adds int32 token-id list columns (A_ids,
+    # B_ids, masked_lm_*_ids) ALONGSIDE the text columns so the loader
+    # decodes batches zero-copy instead of re-tokenizing every epoch;
+    # 1 keeps the original text-only shards (byte-identical to previous
+    # releases). Loaders auto-detect per shard; v1-vs-v2 batches are
+    # byte-identical (tests/test_schema_v2.py).
+    schema_version: int = 2
 
     def __post_init__(self):
         if self.max_seq_length < 8:
@@ -81,6 +88,8 @@ class BertPretrainConfig:
             raise ValueError("tokenizer_engine must be auto|hf|native")
         if self.splitter not in ("rules", "learned"):
             raise ValueError("splitter must be rules|learned")
+        if self.schema_version not in (1, 2):
+            raise ValueError("schema_version must be 1|2")
         if self.max_predictions_per_seq is None:
             self.max_predictions_per_seq = int(
                 np.ceil(self.masked_lm_ratio * self.max_seq_length))
@@ -673,8 +682,8 @@ def materialize_columns(batch, config, tok_info, seed, scope):
     Arrow buffers with vectorized byte gathers (preprocess.arrowcols) —
     between pair construction and the parquet file, no per-row Python
     object exists at all."""
-    from .arrowcols import (concat_aranges, joined_token_strings,
-                            serialized_u16_binary)
+    from .arrowcols import (concat_aranges, int32_list_array,
+                            joined_token_strings, serialized_u16_binary)
     if isinstance(batch, list):
         batch = InstanceBatch.from_pairs(batch, tok_info.cls_id,
                                          tok_info.sep_id)
@@ -695,12 +704,16 @@ def materialize_columns(batch, config, tok_info, seed, scope):
                                + concat_aranges(a_lens)]
         flat_b = batch.seq_ids[np.repeat(offsets + 2 + a_lens, b_lens)
                                + concat_aranges(b_lens)]
-        return {
+        columns = {
             "A": joined_token_strings(flat_a, a_lens, tok_table),
             "B": joined_token_strings(flat_b, b_lens, tok_table),
             "is_random_next": np.asarray(rn, dtype=bool),
             "num_tokens": seq_lens.astype(np.uint16),
-        }, n
+        }
+        if config.schema_version >= 2:
+            columns["A_ids"] = int32_list_array(flat_a, a_lens)
+            columns["B_ids"] = int32_list_array(flat_b, b_lens)
+        return columns, n
 
     masked, selected, ids, a_lens, seq_lens = apply_static_masking(
         batch, config, tok_info, seed, scope)
@@ -714,7 +727,7 @@ def materialize_columns(batch, config, tok_info, seed, scope):
                     np.repeat(2 + a_lens, b_lens) + concat_aranges(b_lens)]
     sel_rows, sel_cols = np.nonzero(selected)            # row-major: sorted
     sel_lens = np.bincount(sel_rows, minlength=n)
-    return {
+    columns = {
         "A": joined_token_strings(flat_a, a_lens, tok_table),
         "B": joined_token_strings(flat_b, b_lens, tok_table),
         "is_random_next": np.asarray(rn, dtype=bool),
@@ -722,13 +735,26 @@ def materialize_columns(batch, config, tok_info, seed, scope):
         "masked_lm_positions": serialized_u16_binary(sel_cols, sel_lens),
         "masked_lm_labels": joined_token_strings(
             ids[sel_rows, sel_cols], sel_lens, tok_table),
-    }, n
+    }
+    if config.schema_version >= 2:
+        columns["A_ids"] = int32_list_array(flat_a, a_lens)
+        columns["B_ids"] = int32_list_array(flat_b, b_lens)
+        columns["masked_lm_positions_ids"] = int32_list_array(sel_cols,
+                                                              sel_lens)
+        columns["masked_lm_label_ids"] = int32_list_array(
+            ids[sel_rows, sel_cols], sel_lens)
+    return columns, n
 
 
 def materialize_rows(batch, config, tok_info, seed, scope):
     """Row-dict view of materialize_columns (debug/txt sink + tests; the
     parquet path consumes the columns directly)."""
     import pyarrow as pa
+    # The schema-v2 id columns are a loader fast path, not part of the
+    # human-readable row view (txt sink format is schema-stable) — don't
+    # build them just to drop them.
+    if config.schema_version != 1:
+        config = dataclasses.replace(config, schema_version=1)
     columns, n = materialize_columns(batch, config, tok_info, seed, scope)
     plain = {
         name: (col.to_pylist() if isinstance(col, pa.Array)

@@ -32,6 +32,27 @@ MASKING_SCHEMA = {
     "masked_lm_positions": pa.binary(),
     "masked_lm_labels": pa.string(),
 }
+# Schema v2: token-id columnar twins of the text columns, ALONGSIDE them
+# (a v2 shard is a strict superset of a v1 shard, so v1 readers keep
+# working). The loader consumes these zero-copy instead of re-tokenizing
+# the strings every epoch.
+TOKEN_ID_SCHEMA = {
+    "A_ids": pa.list_(pa.int32()),
+    "B_ids": pa.list_(pa.int32()),
+}
+MASKING_TOKEN_ID_SCHEMA = {
+    "masked_lm_positions_ids": pa.list_(pa.int32()),
+    "masked_lm_label_ids": pa.list_(pa.int32()),
+}
+# Column names whose presence marks a schema-v2 shard (BERT / BART).
+SCHEMA_V2_MARKERS = ("A_ids", "sentence_ids")
+
+
+def schema_version_of_names(names):
+    """1 or 2 from a parquet schema's column names (per-shard detection:
+    the loader and the manifest's ``__meta__`` entry both use this)."""
+    names = set(names)
+    return 2 if any(m in names for m in SCHEMA_V2_MARKERS) else 1
 
 
 def num_bins(target_seq_length, bin_size):
@@ -50,10 +71,14 @@ def bin_id_of_num_tokens(num_tokens, bin_size, nbins):
     return np.minimum(np.maximum(num_tokens - 1, 0) // bin_size, nbins - 1)
 
 
-def make_schema(masking=False, binned=False):
+def make_schema(masking=False, binned=False, token_ids=False):
     fields = dict(BASE_SCHEMA)
     if masking:
         fields.update(MASKING_SCHEMA)
+    if token_ids:
+        fields.update(TOKEN_ID_SCHEMA)
+        if masking:
+            fields.update(MASKING_TOKEN_ID_SCHEMA)
     if binned:
         fields["bin_id"] = pa.int64()
     return pa.schema(list(fields.items()))
@@ -78,8 +103,10 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
     """
     os.makedirs(out_dir, exist_ok=True)
     written = {}
+    token_ids = "A_ids" in columns  # schema v2 sniffed off the columns
     if bin_size is None:
-        schema = make_schema(masking=masking, binned=False)
+        schema = make_schema(masking=masking, binned=False,
+                             token_ids=token_ids)
         path = os.path.join(out_dir, "part.{}.parquet".format(part_id))
         write_table_atomic(
             pa.table({name: columns.get(name, []) for name in schema.names},
@@ -92,7 +119,7 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
         return written  # row path and ref binning.py:353-431)
 
     nbins = num_bins(target_seq_length, bin_size)
-    schema = make_schema(masking=masking, binned=True)
+    schema = make_schema(masking=masking, binned=True, token_ids=token_ids)
     num_tokens = np.asarray(columns["num_tokens"], dtype=np.int64)
     bins = bin_id_of_num_tokens(num_tokens, bin_size, nbins)
     for b in np.unique(bins):

@@ -419,7 +419,16 @@ class BertBucketProcessor:
         # per process/run, so the cache can never outlive the snapshot it
         # hashed (round-4 VERDICT: the old size-keyed cache could).
         vocab = self.tok_info.vocab_digest
-        return processor_fingerprint(type(self).__name__, vocab, self.config,
+        # schema_version leaves the digest when 1 so pre-upgrade v1 runs
+        # (byte-identical output) stay resumable across the field's
+        # introduction; v2 runs genuinely produce different bytes and
+        # must fingerprint differently.
+        import dataclasses
+        cfg = dataclasses.asdict(self.config)
+        if cfg.get("schema_version") == 1:
+            del cfg["schema_version"]
+        cfg = json.dumps(cfg, sort_keys=True, default=str)
+        return processor_fingerprint(type(self).__name__, vocab, cfg,
                                      self.seed, self.bin_size,
                                      self.output_format,
                                      splitter_digest(self.splitter_params),

@@ -108,9 +108,23 @@ def build_manifest(dir_path, comm=None, log=None):
         return _build_manifest(dir_path, comm, names, mode, log)
 
 
+def _shard_schema_version(path):
+    """Token-id schema version (1|2) off one shard's parquet footer, or
+    None when the footer is unreadable (the verifier's problem to
+    report, not the meta sniffer's)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from ..preprocess.binning import schema_version_of_names
+    try:
+        return schema_version_of_names(pq.read_schema(path).names)
+    except (OSError, pa.ArrowInvalid):
+        return None
+
+
 def _build_manifest(dir_path, comm, names, mode, log):
     sizes = [0] * len(names)
     crcs = [0] * len(names)
+    vflags = [0, 0]  # token-id schema v1 / v2 seen on this rank's stride
     for i in range(comm.rank, len(names), comm.world_size):
         path = os.path.join(dir_path, names[i])
         if mode == "size":
@@ -120,20 +134,38 @@ def _build_manifest(dir_path, comm, names, mode, log):
             # Sizes come from the checksum pass's byte count so a file
             # mutated mid-pass can't record a size/crc from two versions.
             sizes[i], crcs[i] = shard_checksum(path)
+        if mode != "size":
+            # Schema sniff rides the same stride (one footer read per
+            # shard across the whole pod, not per rank). size mode's
+            # contract is stat-only / zero extra reads, so it skips the
+            # sniff and publishes no __meta__ — like it skips the CRC.
+            v = _shard_schema_version(path)
+            if v is not None:
+                vflags[v - 1] = 1
     sizes = comm.allreduce_sum(sizes)
     crcs = comm.allreduce_sum(crcs)
+    vflags = comm.allreduce_sum(vflags)
     manifest = {
         n: ({"bytes": int(s), "crc32": int(c)} if mode != "size"
             else {"bytes": int(s)})
         for n, s, c in zip(names, sizes, crcs)
     }
+    # Reserved __meta__ entry (never a parquet basename, so shard lookups
+    # skip it): {"schema_version": 1|2} when every readable shard agrees,
+    # {"schema_versions": [1, 2]} for a mixed directory (supported: the
+    # loader selects its decode path per shard).
+    versions = [v for v, flag in zip((1, 2), vflags) if flag]
+    if len(versions) == 1:
+        manifest["__meta__"] = {"schema_version": versions[0]}
+    elif versions:
+        manifest["__meta__"] = {"schema_versions": versions}
     if comm.rank == 0:
         atomic_write(os.path.join(dir_path, MANIFEST_NAME),
                      json.dumps(manifest, sort_keys=True))
     comm.barrier()
     if log is not None:
         log("integrity manifest: {} shard(s) in {}".format(
-            len(manifest), dir_path))
+            len(names), dir_path))
     return manifest
 
 

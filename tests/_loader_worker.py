@@ -10,6 +10,14 @@ import json
 import sys
 
 
+def sample_key(s):
+    """Identity string of a raw sample: v1 shards yield token strings,
+    schema-v2 (the default) yields int32 id arrays."""
+    def part(v):
+        return v if isinstance(v, str) else " ".join(map(str, v))
+    return part(s[0]) + "|" + part(s[1])
+
+
 def main():
     rank, world = int(sys.argv[1]), int(sys.argv[2])
     coordinator, shards, vocab = sys.argv[3], sys.argv[4], sys.argv[5]
@@ -27,7 +35,7 @@ def main():
     loader = get_bert_pretrain_data_loader(
         shards, dp_rank=rank, num_dp_groups=world, vocab_file=vocab,
         batch_size=8, base_seed=5, return_raw_samples=True, comm=comm)
-    mine = sorted(s[0] + "|" + s[1] for batch in loader for s in batch)
+    mine = sorted(sample_key(s) for batch in loader for s in batch)
     print("SAMPLES " + json.dumps(mine), flush=True)
 
     # (b) TP-peer identity: every rank of dp group 0 must produce the

@@ -54,7 +54,12 @@ def run_case(corpus_root, vocab_file, out_dir, binned, **kw):
     from lddl_tpu.preprocess import (BertPretrainConfig, get_tokenizer,
                                      run_bert_preprocess)
     tok = get_tokenizer(vocab_file=vocab_file)
-    cfg = BertPretrainConfig(max_seq_length=32, masking=binned)
+    # schema_version=1 pinned: the goldens capture the original text-only
+    # shard bytes, and the v1 writer path must keep producing them
+    # byte-identically (v2 adds columns and is covered by
+    # tests/test_schema_v2.py's batch-level byte-identity instead).
+    cfg = BertPretrainConfig(max_seq_length=32, masking=binned,
+                             schema_version=1)
     run_bert_preprocess(
         {"wikipedia": corpus_root}, out_dir, tok, config=cfg,
         num_blocks=12, sample_ratio=0.9, seed=4242,

@@ -47,7 +47,7 @@ def test_every_rule_is_registered_once():
     assert set(ids) == {
         "global-rng", "wall-clock", "atomic-publish", "unsorted-iteration",
         "swallowed-error", "stage-span", "jit-host-effect",
-        "manifest-determinism",
+        "manifest-determinism", "python-hot-loop",
     }
 
 
@@ -354,6 +354,54 @@ def test_manifest_determinism_ignores_other_functions():
 
 
 # ------------------------------------------------------------ the CI gate
+
+
+def test_python_hot_loop_true_positives():
+    src = """
+        import numpy as np
+
+        def decode(b):
+            for row in b.to_pydict()["A"]:
+                yield row
+
+        def collate(token_lists, vocab):
+            return np.fromiter(
+                (vocab[t] for ts in token_lists for t in ts),
+                dtype=np.int32)
+
+        def lens(col):
+            return [v.as_py() for v in col]
+    """
+    findings = check(src, "lddl_tpu/loader/custom.py",
+                     rules=["python-hot-loop"])
+    assert rule_ids(findings) == ["python-hot-loop"] * 3
+
+
+def test_python_hot_loop_scoped_to_loader_and_suppressible():
+    src = """
+        def anywhere(col):
+            return col.to_pylist()
+    """
+    # Outside lddl_tpu/loader/ the rule never fires (offline stages may
+    # materialize rows — their cost is paid once, not per epoch).
+    assert check(src, "lddl_tpu/preprocess/x.py",
+                 rules=["python-hot-loop"]) == []
+    supp = """
+        def legacy(b):
+            return b.to_pydict()  # v1 shards -- lddl: disable=python-hot-loop
+    """
+    assert check(supp, "lddl_tpu/loader/x.py",
+                 rules=["python-hot-loop"]) == []
+    # Per-SAMPLE (single-generator) fromiter and map() stay allowed:
+    # lengths and offsets are per-row work, not per-token.
+    ok = """
+        import numpy as np
+
+        def lens(seqs):
+            return np.fromiter((len(s) for s in seqs), dtype=np.int64)
+    """
+    assert check(ok, "lddl_tpu/loader/x.py",
+                 rules=["python-hot-loop"]) == []
 
 
 def test_full_tree_has_zero_non_baselined_findings():

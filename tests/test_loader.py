@@ -91,6 +91,11 @@ def test_shuffle_buffer_yields_all(tmp_path):
     assert len(list(buf3)) == 99
 
 
+# Raw-sample identity (v1 token strings / v2 id arrays) — one definition,
+# shared with the multiprocess worker.
+from _loader_worker import sample_key as _sample_key  # noqa: E402
+
+
 def _loader(pipeline, kind, **kw):
     defaults = dict(
         batch_size=16,
@@ -158,9 +163,9 @@ def test_dp_group_sharding(pipeline):
                 return_raw_samples=True)
     c = _loader(pipeline, "dyn", dp_rank=1, num_dp_groups=2,
                 return_raw_samples=True)
-    sa = [s[0] + "|" + s[1] for batch in a for s in batch]
-    sc = [s[0] + "|" + s[1] for batch in c for s in batch]
-    sf = [s[0] + "|" + s[1] for batch in full for s in batch]
+    sa = [_sample_key(s) for batch in a for s in batch]
+    sc = [_sample_key(s) for batch in c for s in batch]
+    sf = [_sample_key(s) for batch in full for s in batch]
     assert sa and sc
     assert len(sa) == len(sc) == len(sf) // 2
     # Which samples get dropped at the truncation boundary may differ

@@ -204,7 +204,8 @@ def test_multiprocess_loader_census_and_dp_contract(mp_corpus, mp_vocab,
     full_loader = get_bert_pretrain_data_loader(
         bal, vocab_file=mp_vocab, batch_size=8, base_seed=5,
         return_raw_samples=True)
-    full = sorted(s[0] + "|" + s[1] for b in full_loader for s in b)
+    from _loader_worker import sample_key
+    full = sorted(sample_key(s) for b in full_loader for s in b)
 
     port = _free_port()
     script = os.path.join(os.path.dirname(__file__), "_loader_worker.py")

@@ -224,11 +224,21 @@ def test_e2e_preprocess_binned_masked(tiny_corpus, tokenizer, tmp_path):
     t = pq.read_table(paths[0])
     assert set(t.column_names) == {
         "A", "B", "is_random_next", "num_tokens",
-        "masked_lm_positions", "masked_lm_labels", "bin_id"}
+        "masked_lm_positions", "masked_lm_labels",
+        # schema v2 (the default): token-id twins the loader decodes
+        # zero-copy; text columns stay alongside for v1 readers.
+        "A_ids", "B_ids", "masked_lm_positions_ids", "masked_lm_label_ids",
+        "bin_id"}
     row = t.to_pylist()[0]
     pos = deserialize_np_array(row["masked_lm_positions"])
     labels = row["masked_lm_labels"].split()
     assert len(pos) == len(labels)
+    # The id columns are exact twins of the text/binary columns.
+    vocab = tokenizer.get_vocab()
+    assert row["A_ids"] == [vocab[t_] for t_ in row["A"].split()]
+    assert row["B_ids"] == [vocab[t_] for t_ in row["B"].split()]
+    assert row["masked_lm_positions_ids"] == pos.tolist()
+    assert row["masked_lm_label_ids"] == [vocab[t_] for t_ in labels]
     seq = (["[CLS]"] + row["A"].split() + ["[SEP]"] + row["B"].split()
            + ["[SEP]"])
     assert row["num_tokens"] == len(seq)

@@ -265,7 +265,11 @@ def _make_shards(d, n_shards=4, rows=6):
 def test_manifest_roundtrip_and_verify_ok(tmp_path):
     paths = _make_shards(tmp_path)
     manifest = integrity.build_manifest(str(tmp_path))
-    assert set(manifest) == {os.path.basename(p) for p in paths}
+    # One entry per shard plus the reserved __meta__ block (schema
+    # version record; never a parquet basename, so lookups skip it).
+    assert set(manifest) == ({os.path.basename(p) for p in paths}
+                             | {"__meta__"})
+    assert manifest["__meta__"]["schema_version"] in (1, 2)
     on_disk = integrity.read_manifest(str(tmp_path))
     assert on_disk == manifest
     good, excluded = integrity.verify_shards(paths)

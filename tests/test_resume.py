@@ -71,7 +71,11 @@ def _bert_processor(vocab, out_dir):
     from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
     from lddl_tpu.preprocess.runner import BertBucketProcessor
     tok = get_tokenizer(vocab_file=vocab)
-    cfg = BertPretrainConfig(max_seq_length=32, masking=True)
+    # schema_version=1: these tests compare against the pinned v1 golden
+    # bytes (see tests/golden_spool.py — resume semantics are
+    # schema-independent).
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True,
+                             schema_version=1)
     return BertBucketProcessor(tok, cfg, 4242, out_dir, 8, "parquet")
 
 

@@ -1,0 +1,281 @@
+"""Schema v2 (token-id columnar shards): byte-identity of the full
+preprocess -> balance -> load pipeline against schema v1, per-shard path
+selection in mixed directories, qserde queue framing, and the device
+prefetch wrapper.
+
+The acceptance contract: identical (seed, epoch, rank, worker) =>
+identical batch bytes for v1 vs v2 shards, thread vs process workers,
+telemetry on and off."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import golden_spool as gs
+from lddl_tpu import observability as obs
+from lddl_tpu.loader import get_bert_pretrain_data_loader, prefetch_to_device
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert sorted(x) == sorted(y)
+        for key in x:
+            np.testing.assert_array_equal(x[key], y[key], err_msg=key)
+
+
+@pytest.fixture(scope="module")
+def pipe(tmp_path_factory):
+    """corpus -> vocab -> preprocess v1 AND v2 (unbinned dynamic + binned
+    static) -> balanced shards."""
+    from lddl_tpu.preprocess import (BertPretrainConfig, get_tokenizer,
+                                     run_bert_preprocess)
+    from lddl_tpu.balance import balance_shards
+    root = tmp_path_factory.mktemp("schema_v2")
+    corpus = gs.build_corpus(str(root / "corpus"))
+    vocab = gs.build_vocab(str(root))
+    tok = get_tokenizer(vocab_file=vocab)
+    out = {"vocab": vocab, "tokenizer": tok, "root": root}
+    for kind, masking, bin_size in (("dyn", False, None), ("bin", True, 16)):
+        for v in (1, 2):
+            pre = str(root / "pre_{}_{}".format(kind, v))
+            bal = str(root / "bal_{}_{}".format(kind, v))
+            run_bert_preprocess(
+                {"wikipedia": corpus}, pre, tok,
+                config=BertPretrainConfig(max_seq_length=64, masking=masking,
+                                          duplicate_factor=2,
+                                          schema_version=v),
+                num_blocks=4, sample_ratio=1.0, seed=0, bin_size=bin_size)
+            balance_shards(pre, bal, 4)
+            out[(kind, v)] = bal
+    return out
+
+
+def _loader(pipe, path, **kw):
+    defaults = dict(batch_size=16, num_workers=2, shuffle_buffer_size=64,
+                    shuffle_buffer_warmup_factor=4,
+                    vocab_file=pipe["vocab"], base_seed=7)
+    defaults.update(kw)
+    return get_bert_pretrain_data_loader(path, **defaults)
+
+
+def test_v2_shards_carry_id_columns_and_manifest_version(pipe):
+    import pyarrow.parquet as pq
+    from lddl_tpu.utils.fs import get_all_parquets_under
+    for kind, id_cols in (("dyn", {"A_ids", "B_ids"}),
+                          ("bin", {"A_ids", "B_ids",
+                                   "masked_lm_positions_ids",
+                                   "masked_lm_label_ids"})):
+        for v in (1, 2):
+            paths = get_all_parquets_under(pipe[(kind, v)])
+            names = set(pq.read_schema(paths[0]).names)
+            assert id_cols <= names if v == 2 else not (id_cols & names)
+            with open(os.path.join(pipe[(kind, v)],
+                                   ".manifest.json")) as f:
+                meta = json.load(f)["__meta__"]
+            assert meta["schema_version"] == v
+
+
+@pytest.mark.parametrize("kind", ("dyn", "bin"))
+def test_v1_v2_byte_identity_thread(pipe, kind):
+    """Same (seed, epoch, rank, worker) => identical batches from text
+    and columnar shards, across two consecutive epochs."""
+    l1 = _loader(pipe, pipe[(kind, 1)])
+    l2 = _loader(pipe, pipe[(kind, 2)])
+    for _ in range(2):  # epoch 0 AND epoch 1 (fresh RNG state per epoch)
+        _assert_batches_equal(list(l1), list(l2))
+
+
+def test_v1_v2_byte_identity_process_and_queue_accounting(pipe, monkeypatch):
+    """Process workers (qserde protocol-5 framing over the queue) must
+    reproduce the v1 thread stream bit-for-bit, and account the framed
+    bytes they shipped."""
+    monkeypatch.setenv("LDDL_TPU_FORCE_PROCESS_WORKERS", "1")
+    ref = list(_loader(pipe, pipe[("dyn", 1)]))
+    lp = _loader(pipe, pipe[("dyn", 2)], worker_mode="process")
+    try:
+        got = list(lp)
+        _assert_batches_equal(ref, got)
+        assert lp.queue_batches == len(got)
+        assert lp.queue_bytes > 0
+    finally:
+        lp.shutdown_workers()
+
+
+def test_v1_v2_byte_identity_packed(pipe):
+    kw = dict(pack_seq_length=64, pack_rows=4, pack_max_per_row=8)
+    p1 = list(_loader(pipe, pipe[("dyn", 1)], **kw))
+    p2 = list(_loader(pipe, pipe[("dyn", 2)], **kw))
+    _assert_batches_equal(p1, p2)
+
+
+def test_v1_v2_byte_identity_with_telemetry(pipe, tmp_path):
+    """Telemetry armed: batches stay byte-identical AND the per-schema
+    decode counters prove each path actually ran."""
+    assert not obs.enabled()
+    off = list(_loader(pipe, pipe[("bin", 2)]))
+    obs.configure(dir=str(tmp_path / "metrics"))
+    try:
+        on2 = list(_loader(pipe, pipe[("bin", 2)]))
+        on1 = list(_loader(pipe, pipe[("bin", 1)]))
+        reg = obs.registry()
+        assert reg.counter("loader_decode_columnar_batches_total").total() > 0
+        assert reg.counter("loader_decode_legacy_batches_total").total() > 0
+    finally:
+        obs.disable()
+    _assert_batches_equal(off, on2)
+    _assert_batches_equal(on1, on2)
+
+
+def test_mixed_directory_per_shard_selection(pipe, tmp_path):
+    """Half v1 shards + half v2 shards in ONE directory: per-shard path
+    selection must not change a single batch byte vs the pure-v1 dir."""
+    import shutil
+    from lddl_tpu.balance import generate_num_samples_cache
+    from lddl_tpu.resilience.integrity import build_manifest
+    mixed = tmp_path / "mixed"
+    mixed.mkdir()
+    for i in range(4):
+        src = pipe[("dyn", 1 if i < 2 else 2)]
+        shutil.copy(os.path.join(src, "shard-{}.parquet".format(i)),
+                    mixed / "shard-{}.parquet".format(i))
+    generate_num_samples_cache(str(mixed))
+    build_manifest(str(mixed))
+    # A mixed directory declares BOTH versions, not an arbitrary one.
+    with open(mixed / ".manifest.json") as f:
+        assert json.load(f)["__meta__"] == {"schema_versions": [1, 2]}
+    ref = list(_loader(pipe, pipe[("dyn", 1)]))
+    got = list(_loader(pipe, str(mixed)))
+    _assert_batches_equal(ref, got)
+
+
+def test_resume_fingerprints_distinguish_schema_but_not_v1_upgrades(pipe):
+    """v2 output bytes differ from v1, so fingerprints must differ; the
+    v1 fingerprint must NOT include the schema_version field at all, so
+    runs started before the field existed stay resumable."""
+    import dataclasses
+    import json as json_mod
+    from lddl_tpu.preprocess import BertPretrainConfig
+    from lddl_tpu.preprocess.runner import (BertBucketProcessor,
+                                            processor_fingerprint,
+                                            splitter_digest)
+    from lddl_tpu.preprocess.binning import DEFAULT_PARQUET_COMPRESSION
+
+    def fp(v):
+        cfg = BertPretrainConfig(max_seq_length=64, schema_version=v)
+        return BertBucketProcessor(pipe["tokenizer"], cfg, 1, "/tmp/x",
+                                   None, "parquet").fingerprint()
+
+    assert fp(1) != fp(2)
+    # Pre-upgrade replay: the old code hashed the config dataclass (which
+    # had no schema_version field) directly.
+    cfg = BertPretrainConfig(max_seq_length=64, schema_version=1)
+    legacy_view = dataclasses.asdict(cfg)
+    del legacy_view["schema_version"]
+    proc = BertBucketProcessor(pipe["tokenizer"], cfg, 1, "/tmp/x", None,
+                               "parquet")
+    legacy = processor_fingerprint(
+        "BertBucketProcessor", proc.tok_info.vocab_digest,
+        json_mod.dumps(legacy_view, sort_keys=True, default=str), 1, None,
+        "parquet", splitter_digest(None),
+        "codec=" + DEFAULT_PARQUET_COMPRESSION)
+    assert fp(1) == legacy
+
+
+def test_bart_v1_v2_byte_identity(pipe):
+    from lddl_tpu.preprocess.bart import (BartPretrainConfig,
+                                          run_bart_preprocess)
+    from lddl_tpu.balance import balance_shards
+    from lddl_tpu.loader.bart import get_bart_pretrain_data_loader
+    root = pipe["root"]
+    dirs = {}
+    for v, tok in ((1, None), (2, pipe["tokenizer"])):
+        pre = str(root / "bart_pre_{}".format(v))
+        bal = str(root / "bart_bal_{}".format(v))
+        run_bart_preprocess({"wikipedia": str(root / "corpus")}, pre,
+                            config=BartPretrainConfig(target_seq_length=48),
+                            num_blocks=4, sample_ratio=1.0, seed=0,
+                            tokenizer=tok)
+        balance_shards(pre, bal, 4)
+        dirs[v] = bal
+    kw = dict(vocab_file=pipe["vocab"], batch_size=8, num_workers=2,
+              base_seed=3, max_seq_length=48, shuffle_buffer_size=64,
+              shuffle_buffer_warmup_factor=4)
+    b1 = list(get_bart_pretrain_data_loader(dirs[1], **kw))
+    b2 = list(get_bart_pretrain_data_loader(dirs[2], **kw))
+    _assert_batches_equal(b1, b2)
+
+
+# ------------------------------------------------------------------ qserde
+
+
+def test_qserde_roundtrip_preserves_arrays_and_structure():
+    from lddl_tpu.loader import qserde
+    base = np.arange(64, dtype=np.int32)
+    batch = {
+        "input_ids": np.arange(12, dtype=np.int32).reshape(3, 4),
+        "f64": np.linspace(0, 1, 5),
+        "views": [base[3:9], base[40:40]],  # incl. an empty slice
+        "meta": ("x", 3, True, b"raw"),
+    }
+    out = qserde.decode(qserde.encode(batch))
+    assert sorted(out) == sorted(batch)
+    np.testing.assert_array_equal(out["input_ids"], batch["input_ids"])
+    assert out["input_ids"].dtype == np.int32
+    np.testing.assert_array_equal(out["f64"], batch["f64"])
+    for a, b in zip(out["views"], batch["views"]):
+        np.testing.assert_array_equal(a, b)
+    assert out["meta"] == batch["meta"]
+    # Consumers may mutate batches (thread mode hands over writable
+    # arrays; process mode must match).
+    out["input_ids"][0, 0] = 99
+    assert out["input_ids"][0, 0] == 99
+
+
+def test_qserde_raw_sample_batches():
+    """The packed path ships RAW sample tuples through process workers:
+    tuples of int32 views (v2) or strings (v1) survive framing."""
+    from lddl_tpu.loader import qserde
+    flat = np.arange(100, dtype=np.int32)
+    batch = [(flat[0:7], flat[7:9], np.bool_(True)),
+             ("alpha beta", "gamma", 0)]
+    out = qserde.decode(qserde.encode(batch))
+    np.testing.assert_array_equal(out[0][0], flat[0:7])
+    np.testing.assert_array_equal(out[0][1], flat[7:9])
+    assert bool(out[0][2]) is True
+    assert out[1] == batch[1]
+
+
+# --------------------------------------------------------------- prefetch
+
+
+def test_prefetch_to_device_order_and_reiteration():
+    batches = [{"input_ids": np.full((2, 2), i)} for i in range(7)]
+    moved = []
+
+    def fake_put(b):
+        moved.append(int(b["input_ids"][0, 0]))
+        return {"input_ids": b["input_ids"] + 100}
+
+    wrapped = prefetch_to_device(batches, device_put=fake_put, depth=2)
+    for epoch in range(2):  # re-iterable, like DataLoader
+        got = [int(b["input_ids"][0, 0]) for b in wrapped]
+        assert got == [100 + i for i in range(7)]
+    assert moved == list(range(7)) * 2
+    assert len(wrapped) == 7
+
+
+def test_prefetch_to_device_propagates_errors():
+    def boom():
+        yield {"input_ids": np.zeros((1, 1))}
+        raise RuntimeError("loader exploded")
+
+    class Once:
+        def __iter__(self):
+            return boom()
+
+    wrapped = prefetch_to_device(Once(), device_put=lambda b: b)
+    with pytest.raises(RuntimeError, match="loader exploded"):
+        list(wrapped)

@@ -12,4 +12,17 @@ if python -c "import pyflakes" >/dev/null 2>&1; then
 else
     python -m compileall -q lddl_tpu tools benchmarks
 fi
+
+# Non-gating loader health sample: a 1 MB v1-vs-v2 loader_bench smoke that
+# publishes LOADER_BENCH_SMOKE.json as a CI artifact. Opt-in via
+# LDDL_TPU_CI_SMOKE_BENCH=1 (it costs ~a minute of preprocessing, which
+# the static gate itself must not) and NEVER fails the check — the
+# artifact is for humans watching throughput drift, not a hard gate.
+if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
+    if JAX_PLATFORMS=cpu python benchmarks/loader_bench.py --smoke; then
+        echo "ci_check: loader_bench smoke artifact written (non-gating)"
+    else
+        echo "ci_check: loader_bench smoke FAILED (non-gating, ignored)" >&2
+    fi
+fi
 echo "ci_check: OK"
