@@ -13,7 +13,9 @@ any finding not in the checked-in baseline
 """
 
 from .core import (  # noqa: F401
+    ANALYSIS_VERSION,
     DEFAULT_BASELINE,
+    DEFAULT_CACHE,
     Finding,
     REPO_ROOT,
     Report,
@@ -28,6 +30,9 @@ from .core import (  # noqa: F401
     run_check,
     split_baselined,
 )
-from . import rules  # noqa: F401  (imports register the rule set)
+from . import rules  # noqa: F401  (imports register the syntactic rules)
+from . import flow_rules  # noqa: F401  (registers the flow rules)
+from . import dataflow, project  # noqa: F401  (taint engine + model)
+from .sarif import to_sarif  # noqa: F401
 
 RULE_IDS = tuple(r.id for r in all_rules())

@@ -29,15 +29,24 @@ Framework pieces:
 
 import ast
 import fnmatch
+import hashlib
 import json
 import os
 import re
+import time
 
 # Repo root = dirname of the package that contains lddl_tpu/.
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 DEFAULT_BASELINE = os.path.join("tools", "lddl_check_baseline.json")
+
+# Repo-relative default location of the AST+summary cache (content-hash
+# keyed; see _Cache). Safe to delete at any time.
+DEFAULT_CACHE = ".lddl_check_cache.json"
+
+# Bump to invalidate every cache entry when rule/engine semantics change.
+ANALYSIS_VERSION = 1
 
 # The directive may sit anywhere inside a comment ("# why ... lddl:
 # disable=x"), but must be after a '#' so string literals never suppress.
@@ -164,6 +173,10 @@ class Rule(object):
     allow = ()
     # If set, the rule only runs on files matching one of these patterns.
     only = None
+    # "file" rules run per file via :meth:`run`; "project" rules are fed
+    # by the whole-tree dataflow engine (see flow_rules.py) and use
+    # allow/only purely as finding-path filters.
+    scope = "file"
 
     def applies_to(self, path):
         if self.only is not None and not _match_any(path, self.only):
@@ -286,25 +299,39 @@ def load_baseline(path):
     return [e for e in entries if isinstance(e, dict)]
 
 
-def baseline_entry(finding, reason=""):
-    return {"rule": finding.rule, "path": finding.path,
-            "match": finding.snippet, "reason": reason}
+def baseline_entry(finding, reason="", count=1):
+    entry = {"rule": finding.rule, "path": finding.path,
+             "match": finding.snippet, "reason": reason}
+    if count != 1:
+        entry["count"] = count
+    return entry
 
 
 def split_baselined(findings, entries):
     """Partition findings into (new, baselined) against baseline entries.
-    Each entry absorbs any number of findings with the same
-    (rule, path, stripped-line) identity."""
-    keys = {(e.get("rule"), e.get("path"), e.get("match")) for e in entries}
+
+    Matching is COUNT-aware: an entry absorbs ``count`` findings
+    (default 1) with the same (rule, path, stripped-line) identity, so
+    pasting a second copy of a baselined line into the same file is a new
+    finding, not a free ride on the first copy's grandfathering."""
+    remaining = {}
+    for e in entries:
+        key = (e.get("rule"), e.get("path"), e.get("match"))
+        remaining[key] = remaining.get(key, 0) + int(e.get("count", 1))
     new, old = [], []
-    for f in findings:
-        (old if f.key() in keys else new).append(f)
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if remaining.get(f.key(), 0) > 0:
+            remaining[f.key()] -= 1
+            old.append(f)
+        else:
+            new.append(f)
     return new, old
 
 
 class Report(object):
     """Result of a tree-wide run: new findings, baselined findings,
-    inline-suppressed findings, parse errors, files analyzed."""
+    inline-suppressed findings, parse errors, files analyzed, and cache/
+    timing stats."""
 
     def __init__(self):
         self.new = []
@@ -312,6 +339,8 @@ class Report(object):
         self.suppressed = []
         self.errors = []  # (path, message)
         self.files = 0
+        self.files_cached = 0  # served from the content-hash cache
+        self.elapsed_s = 0.0
 
     @property
     def ok(self):
@@ -321,6 +350,8 @@ class Report(object):
         return {
             "ok": self.ok,
             "files": self.files,
+            "files_cached": self.files_cached,
+            "elapsed_s": round(self.elapsed_s, 3),
             "findings": [f.to_dict() for f in self.new],
             "baselined": [f.to_dict() for f in self.baselined],
             "suppressed": [f.to_dict() for f in self.suppressed],
@@ -328,29 +359,278 @@ class Report(object):
         }
 
 
-def run_check(paths, rules=None, baseline_path=None, root=None):
+class _Cache(object):
+    """Content-hash keyed per-file cache of parse + analysis artifacts.
+
+    Each entry stores, for one (file content, ANALYSIS_VERSION, rule-set)
+    state: the full-rule-set syntactic findings and suppressions, the
+    suppression-comment map, the module's dataflow facts (phase A of
+    :mod:`.dataflow`), and a resolution stub (functions + import aliases)
+    so the project model can be rebuilt WITHOUT re-parsing cache hits.
+    The interprocedural fixpoint (phase B) is always recomputed — it is
+    cheap, and it is how an edit in one file updates findings in its
+    callers and callees."""
+
+    def __init__(self, path, rule_sig):
+        self.path = path
+        self.rule_sig = rule_sig
+        self.entries = {}
+        self.dirty = False
+        if path and os.path.isfile(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    data = json.load(f)
+                if (data.get("version") == ANALYSIS_VERSION
+                        and data.get("rules") == rule_sig):
+                    self.entries = data.get("files", {})
+            except (OSError, ValueError):
+                # A corrupt/unreadable cache reads as empty: every file
+                # re-analyzes and the next save rewrites it.
+                self.entries = {}
+
+    def get(self, relpath, content_hash):
+        entry = self.entries.get(relpath)
+        if entry is not None and entry.get("hash") == content_hash:
+            return entry
+        return None
+
+    def put(self, relpath, entry):
+        self.entries[relpath] = entry
+        self.dirty = True
+
+    def save(self):
+        if not self.path or not self.dirty:
+            return
+        try:
+            with open(self.path, "w", encoding="utf-8") as f:
+                json.dump({"version": ANALYSIS_VERSION,
+                           "rules": self.rule_sig,
+                           "files": self.entries}, f)
+        # Best-effort accelerator: an unwritable cache (read-only
+        # checkout, full disk) must never fail the check itself.
+        except OSError:  # lddl: disable=swallowed-error
+            pass
+
+
+def _sha256(text):
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+_SELF_DIGEST = None
+
+
+def _rule_signature():
+    """Cache key component: registered rule ids PLUS a digest of the
+    analysis package's own sources, so editing a rule or the engine
+    invalidates every cached entry without a manual ANALYSIS_VERSION
+    bump. Entries cache the FULL rule set's results (``--rules`` filters
+    at report time), so the signature ignores any per-run filter."""
+    global _SELF_DIGEST
+    if _SELF_DIGEST is None:
+        h = hashlib.sha256()
+        pkg_dir = os.path.dirname(os.path.abspath(__file__))
+        for name in sorted(os.listdir(pkg_dir)):
+            if name.endswith(".py"):
+                with open(os.path.join(pkg_dir, name), "rb") as f:
+                    h.update(name.encode())
+                    h.update(f.read())
+        _SELF_DIGEST = h.hexdigest()
+    return sorted(r.id for r in _REGISTRY) + [_SELF_DIGEST]
+
+
+def run_check(paths, rules=None, baseline_path=None, root=None,
+              cache_path=None, report_paths=None):
     """Analyze every .py under ``paths`` and return a :class:`Report`.
 
-    ``baseline_path`` defaults to the checked-in
-    ``tools/lddl_check_baseline.json`` (pass ``baseline_path=""`` to run
-    without a baseline)."""
+    - ``baseline_path`` defaults to the checked-in
+      ``tools/lddl_check_baseline.json`` (pass ``""`` to disable).
+    - ``cache_path``: AST+summary cache file. ``None`` disables caching;
+      the CLI passes ``<root>/.lddl_check_cache.json`` by default.
+    - ``report_paths``: optional iterable of repo-relative paths —
+      findings are REPORTED only for these files while the analysis (and
+      the interprocedural fixpoint) still covers all of ``paths``. This
+      is the ``--changed-only`` fast path.
+    """
+    from . import flow_rules as _flow
+    from . import dataflow as _dataflow
+    from . import project as _project
+
+    t0 = time.monotonic()
     root = root or REPO_ROOT
     rules = all_rules() if rules is None else rules
+    selected_ids = {r.id for r in rules}
+    file_rules = [r for r in all_rules() if r.scope == "file"]
+    flow_rules_by_id = {r.id: r for r in all_rules()
+                        if r.scope == "project"}
+    want_flow = any(r.scope == "project" for r in rules)
     if baseline_path is None:
         baseline_path = os.path.join(root, DEFAULT_BASELINE)
     entries = load_baseline(baseline_path) if baseline_path else []
     report = Report()
+    # The analyzed path set is part of the cache signature: facts
+    # extracted under a PARTIAL project model (an explicit-path run)
+    # record unresolvable cross-package calls as opaque externals, and
+    # reusing them in a full-tree run would silently drop flow findings.
+    cache = _Cache(cache_path,
+                   _rule_signature() + [sorted(str(p) for p in paths)])
+
+    proj = _project.Project()
+    parsed_modules = []  # ModuleInfo needing fact extraction
+    module_facts = []  # dataflow._ModuleFacts for every healthy file
+    per_file = {}  # relpath -> {"supp": {...}, "lines": [...]}
+    findings = []  # pre-baseline, post-suppression
+    cache_entries_pending = {}  # relpath -> entry missing "facts"
+
+    seen_paths = set()
     for abspath, relpath in iter_python_files(paths, root=root):
+        if relpath in seen_paths:
+            # Overlapping path arguments (e.g. "lddl_tpu
+            # lddl_tpu/preprocess") must not analyze a file twice: the
+            # count-aware baseline would see the duplicate findings as
+            # NEW.
+            continue
+        seen_paths.add(relpath)
         report.files += 1
         try:
             with open(abspath, "r", encoding="utf-8") as f:
                 source = f.read()
-            findings, suppressed = analyze_source(source, relpath, rules)
+        except OSError as e:
+            report.errors.append((relpath, "unreadable: {}".format(e)))
+            continue
+        content_hash = _sha256(source)
+        lines = source.splitlines()
+        hit = cache.get(relpath, content_hash)
+        if hit is not None:
+            report.files_cached += 1
+            supp = {int(k): set(v) for k, v in hit["supp"].items()}
+            per_file[relpath] = {"supp": supp, "lines": lines}
+            for d in hit["findings"]:
+                f = Finding(d["rule"], d["path"], d["line"], d["col"],
+                            d["message"], d["snippet"])
+                if f.rule in selected_ids:
+                    findings.append(f)
+            for d in hit["suppressed"]:
+                f = Finding(d["rule"], d["path"], d["line"], d["col"],
+                            d["message"], d["snippet"])
+                if f.rule in selected_ids:
+                    report.suppressed.append(f)
+            module_facts.append(
+                _dataflow._ModuleFacts.from_dict(hit["facts"]))
+            _add_stub_module(proj, relpath, hit["stub"])
+            continue
+        try:
+            tree = ast.parse(source, filename=relpath)
         except SyntaxError as e:
             report.errors.append((relpath, "syntax error: {}".format(e)))
             continue
-        report.suppressed.extend(suppressed)
-        new, old = split_baselined(findings, entries)
-        report.new.extend(new)
-        report.baselined.extend(old)
+        ctx = Context(relpath, source, tree)
+        supp = suppressions(ctx.lines)
+        per_file[relpath] = {"supp": supp, "lines": lines}
+        raw, kept, supped = [], [], []
+        for rule in file_rules:
+            if not rule.applies_to(relpath):
+                continue
+            for f in rule.run(ctx):
+                raw.append(f)
+        for f in raw:
+            (supped if f.rule in supp.get(f.line, ()) else kept).append(f)
+        findings.extend(f for f in kept if f.rule in selected_ids)
+        report.suppressed.extend(f for f in supped
+                                 if f.rule in selected_ids)
+        mod = proj.add_source(relpath, source, tree=tree)
+        parsed_modules.append(mod)
+        cache_entries_pending[relpath] = {
+            "hash": content_hash,
+            "findings": [f.to_dict() for f in kept],
+            "suppressed": [f.to_dict() for f in supped],
+            "supp": {str(k): sorted(v) for k, v in supp.items()},
+            "stub": _stub_of_module(mod),
+        }
+
+    # Phase A for newly-parsed files (needs the COMPLETE project model so
+    # cross-module calls resolve), then cache them.
+    for mod in parsed_modules:
+        mf = _dataflow.extract_module_facts(proj, mod)
+        module_facts.append(mf)
+        entry = cache_entries_pending[mod.path]
+        entry["facts"] = mf.to_dict()
+        cache.put(mod.path, entry)
+
+    # Phase B: the interprocedural fixpoint + flow findings.
+    if want_flow and module_facts:
+        for rule_id, path, lineno, message in _flow.run_flow_analysis(
+                module_facts):
+            rule = flow_rules_by_id.get(rule_id)
+            if rule is None or rule_id not in selected_ids:
+                continue
+            if not rule.applies_to(path):
+                continue
+            info = per_file.get(path)
+            snippet = ""
+            if info and 1 <= lineno <= len(info["lines"]):
+                snippet = info["lines"][lineno - 1].strip()
+            f = Finding(rule_id, path, lineno, 0, message, snippet)
+            if info and rule_id in info["supp"].get(lineno, ()):
+                report.suppressed.append(f)
+            else:
+                findings.append(f)
+
+    if report_paths is not None:
+        wanted = set(report_paths)
+        findings = [f for f in findings if f.path in wanted]
+        report.suppressed = [f for f in report.suppressed
+                             if f.path in wanted]
+
+    new, old = split_baselined(findings, entries)
+    report.new.extend(new)
+    report.baselined.extend(old)
+    report.new.sort(key=lambda f: (f.path, f.line, f.rule))
+    report.baselined.sort(key=lambda f: (f.path, f.line, f.rule))
+    cache.save()
+    report.elapsed_s = time.monotonic() - t0
     return report
+
+
+def _stub_of_module(mod):
+    """Resolution-only snapshot of a parsed module for the cache: enough
+    for OTHER files' call sites to resolve into it without re-parsing."""
+    return {
+        "modname": mod.modname,
+        "aliases": mod.aliases,
+        "functions": [
+            {"local": local, "name": fi.name, "cls": fi.cls,
+             "params": fi.params, "lineno": fi.lineno}
+            for local, fi in sorted(mod.functions.items())
+        ],
+    }
+
+
+def _add_stub_module(proj, relpath, stub):
+    from .project import FunctionInfo, ModuleInfo
+
+    mod = ModuleInfo.__new__(ModuleInfo)
+    mod.path = relpath
+    mod.source = ""
+    mod.lines = []
+    mod.tree = None
+    mod.modname = stub["modname"]
+    mod.aliases = dict(stub["aliases"])
+    mod.functions = {}
+    mod.global_assigns = {}
+    for fd in stub["functions"]:
+        qual = "{}.{}".format(mod.modname, fd["local"])
+        fi = FunctionInfo.__new__(FunctionInfo)
+        fi.qualname = qual
+        fi.name = fd["name"]
+        fi.cls = fd["cls"]
+        fi.module = mod
+        fi.path = relpath
+        fi.node = None
+        fi.lineno = fd["lineno"]
+        fi.params = list(fd["params"])
+        mod.functions[fd["local"]] = fi
+    proj.modules_by_path[relpath] = mod
+    proj.modules_by_name[mod.modname] = mod
+    for fi in mod.functions.values():
+        proj.functions[fi.qualname] = fi
+    return mod

@@ -1,0 +1,110 @@
+"""The four interprocedural flow rules.
+
+Each subsumes a syntactic ancestor in :mod:`.rules` by catching the
+*helper-laundered* variant the ancestor cannot see: the syntactic rules
+fire when a guarded pattern appears inside one function; the flow rules
+fire when the pattern's **value or effect crosses a function (or
+module-global) boundary** before reaching a sink. Same-function flows are
+deliberately NOT reported here — that keeps the two rule families
+non-overlapping, so one violation produces one finding.
+
+These are ``scope = "project"`` rules: :func:`core.run_check` builds the
+whole-tree :mod:`.project` model, extracts per-file dataflow facts
+(cached by content hash), runs the :mod:`.dataflow` fixpoint once, and
+routes each emitted finding through the rule whose id it carries — so
+``allow`` lists, ``--rules`` filters, inline suppressions, and the
+baseline all behave exactly as they do for syntactic rules.
+"""
+
+from .core import Rule, _match_any, register
+from . import dataflow
+
+# Shard-publishing packages (mirrors rules._SHARD_PKGS): call sites here
+# must only publish through resilience.io.
+SHARD_PKGS = ("lddl_tpu/preprocess/*", "lddl_tpu/balance/*",
+              "lddl_tpu/loader/*", "lddl_tpu/resilience/*",
+              "lddl_tpu/utils/fs.py")
+
+# The sanctioned atomic publisher: its internals ARE the tmp+fsync+replace
+# dance, and effects never propagate out of it.
+SANCTIONED = ("lddl_tpu/resilience/io.py",)
+
+# Files whose raw writes never land in shard directories by construction
+# (trace/metrics files, generated C++ build trees, pre-pipeline downloads,
+# the analyzer's own cache, test-only fault latches) — excluded as
+# publish-path effect SOURCES so a shard-package call into them is not a
+# publish violation.
+PUBLISH_SOURCE_EXEMPT = (
+    "lddl_tpu/observability/*", "lddl_tpu/analysis/*", "lddl_tpu/native/*",
+    "lddl_tpu/download/*", "lddl_tpu/resilience/faults.py",
+)
+
+
+class FlowRule(Rule):
+    """Base for project-scope rules: run via the dataflow engine, not per
+    file. ``run`` is unused; ``applies_to`` still gates findings by the
+    finding's path."""
+
+    scope = "project"
+
+    def run(self, ctx):  # pragma: no cover - project rules don't run here
+        return ()
+
+
+@register
+class WallClockFlowRule(FlowRule):
+    id = "wall-clock-flow"
+    doc = ("flow-aware wall-clock: clock/pid/uuid/hostname values that "
+           "reach manifest/ledger content or publish arguments through "
+           "any helper chain (subsumes wall-clock across functions)")
+    allow = ("lddl_tpu/observability/*", "benchmarks/*",
+             # tmp-file names embed the pid on purpose: the pre-publish
+             # scratch name is never part of the published state.
+             "lddl_tpu/resilience/io.py")
+
+
+@register
+class RngFlowRule(FlowRule):
+    id = "rng-flow"
+    doc = ("flow-aware RNG: draws on unkeyed generators "
+           "(np.random.default_rng() / random.Random() with no key) that "
+           "were laundered through helpers or module globals before "
+           "shaping data (subsumes global-rng across functions)")
+    allow = ("lddl_tpu/models/testing.py",)
+
+
+@register
+class FsOrderFlowRule(FlowRule):
+    id = "fs-order-flow"
+    doc = ("flow-aware FS order: listdir/glob/walk results that cross a "
+           "function boundary and are then iterated, indexed, or rendered "
+           "into strings/error text without an intervening sorted() "
+           "(subsumes unsorted-iteration across functions)")
+    allow = ()
+
+
+@register
+class PublishPathFlowRule(FlowRule):
+    id = "publish-path-flow"
+    doc = ("flow-aware atomic publish: shard-package call paths that "
+           "reach a raw write (write-mode open, pq.write_table) in a "
+           "helper OUTSIDE the shard packages without passing through "
+           "resilience.io (subsumes atomic-publish across functions)")
+    allow = ("lddl_tpu/resilience/io.py",)
+
+
+FLOW_RULE_IDS = ("wall-clock-flow", "rng-flow", "fs-order-flow",
+                 "publish-path-flow")
+
+
+def run_flow_analysis(module_facts):
+    """Phase B over cached/extracted per-file facts. Returns
+    ``[(rule_id, path, lineno, message)]`` BEFORE allow-list, suppression
+    and baseline filtering (core.run_check applies those)."""
+    return dataflow.analyze_modules(
+        module_facts,
+        shard_pkg=lambda p: _match_any(p, SHARD_PKGS),
+        publish_source_ok=lambda p: not _match_any(
+            p, PUBLISH_SOURCE_EXEMPT),
+        sanctioned=lambda p: _match_any(p, SANCTIONED),
+    ).findings

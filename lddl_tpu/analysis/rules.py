@@ -452,11 +452,15 @@ _PY_MATERIALIZERS = frozenset({"as_py", "to_pylist", "to_pydict", "tolist"})
 @register
 class PythonHotLoopRule(Rule):
     id = "python-hot-loop"
-    doc = ("no per-token Python iteration on the loader hot path "
-           "(.as_py()/.to_pylist()/.to_pydict()/.tolist(), nested-"
-           "generator np.fromiter over token streams) — decode/collate "
-           "stay columnar; justified schema-v1 fallbacks are baselined")
-    only = ("lddl_tpu/loader/*",)
+    doc = ("no per-token Python iteration on the loader, preprocess, or "
+           "balance hot paths (.as_py()/.to_pylist()/.to_pydict()/"
+           ".tolist(), nested-generator np.fromiter over token streams) "
+           "— stay columnar; justified fallbacks carry suppressions")
+    # Loader per-sample work multiplies by epochs; preprocess/balance
+    # per-token work multiplies by corpus bytes (the ROADMAP's native
+    # preprocess item starts by making these loops visible).
+    only = ("lddl_tpu/loader/*", "lddl_tpu/preprocess/*",
+            "lddl_tpu/balance/*")
 
     def run(self, ctx):
         for node in ast.walk(ctx.tree):
@@ -468,12 +472,13 @@ class PythonHotLoopRule(Rule):
                 yield ctx.finding(
                     self.id, node,
                     ".{}() materializes one Python object per element; on "
-                    "the loader hot path that is per-token work every "
-                    "epoch — decode Arrow list<int32> columns to numpy "
-                    "views (loader.bert._list_views) or move the work "
-                    "offline to preprocess (schema v2); suppress with a "
-                    "justification for v1-fallback or error-path use"
-                    .format(func.attr))
+                    "a pipeline hot path that is per-token work per epoch "
+                    "(loader) or per corpus byte (preprocess/balance) — "
+                    "decode Arrow list<int32> columns to numpy views "
+                    "(loader.bert._list_views), keep numpy arrays "
+                    "columnar, or move the work offline; suppress with a "
+                    "justification for once-per-process tables, debug "
+                    "sinks, or v1 fallbacks".format(func.attr))
                 continue
             name = ctx.resolve_call(node)
             if name == "numpy.fromiter" and node.args:

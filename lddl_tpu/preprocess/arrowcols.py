@@ -73,7 +73,10 @@ def joined_token_strings(flat_ids, row_lens, table):
     row_bytes = cum[row_tok_starts + row_lens] - cum[row_tok_starts]
     offsets = _offsets32(row_bytes)
 
-    sel = ((flat_ids << 1) | has_space).tolist()
+    # Deliberate fast path: ONE C-level tolist per batch so the bytes
+    # join below runs as C map(__getitem__) — measured faster than any
+    # numpy gather over object arrays (VERDICT.md round 3).
+    sel = ((flat_ids << 1) | has_space).tolist()  # lddl: disable=python-hot-loop
     data = b"".join(map(table.spaced.__getitem__, sel))
     return pa.Array.from_buffers(
         pa.utf8(), n, [None, pa.py_buffer(offsets), pa.py_buffer(data)])

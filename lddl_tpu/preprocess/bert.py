@@ -129,7 +129,9 @@ class TokenizerInfo:
             self.id_to_token[i] = tok
         self.id_to_token = np.asarray(
             ["" if t is None else t for t in self.id_to_token], dtype=object)
-        self.token_list = self.id_to_token.tolist()  # plain-list fast path
+        # Once per process, vocab-sized (not corpus-sized): the plain-list
+        # form feeds C-level joins downstream. -- lddl: disable=python-hot-loop
+        self.token_list = self.id_to_token.tolist()
         self.cls_id = vocab["[CLS]"]
         self.sep_id = vocab["[SEP]"]
         self.mask_id = vocab["[MASK]"]
@@ -375,7 +377,10 @@ def instances_from_texts(texts, tok_info, config, seed, bucket,
 
 def _documents_from_texts_native(texts, nat):
     ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
-    flat = ids.tolist()
+    # ONE C-level tolist per gather batch; the per-sentence views below
+    # are C-level list slices, and downstream pair assembly concatenates
+    # sentences with list + (numpy slices would change those semantics).
+    flat = ids.tolist()  # lddl: disable=python-hot-loop
     ends = np.cumsum(sent_lens)
     documents = []
     k = 0
@@ -757,8 +762,10 @@ def materialize_rows(batch, config, tok_info, seed, scope):
         config = dataclasses.replace(config, schema_version=1)
     columns, n = materialize_columns(batch, config, tok_info, seed, scope)
     plain = {
-        name: (col.to_pylist() if isinstance(col, pa.Array)
-               else col.tolist())
+        # Debug/test row view only (see docstring): the parquet path
+        # consumes the columns directly and never takes this branch.
+        name: (col.to_pylist() if isinstance(col, pa.Array)  # lddl: disable=python-hot-loop
+               else col.tolist())  # lddl: disable=python-hot-loop
         for name, col in columns.items()
     }
     names = list(plain)
@@ -811,4 +818,6 @@ def create_masked_lm_predictions(tokens, vocab_words, g, masked_lm_ratio,
         new_id = int(masked[0, p])
         if new_id != int(ids[0, p]):  # keep path: leave original verbatim
             tokens[p] = id_to_tok[new_id]
+    # Per-row API-parity helper for tests/docs (see docstring); the batch
+    # kernels above are the pipeline path. -- lddl: disable=python-hot-loop
     return positions.tolist(), labels

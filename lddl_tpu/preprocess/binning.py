@@ -135,7 +135,9 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
             elif isinstance(col, np.ndarray):
                 sub[name] = col[idx]
             else:
-                sub[name] = [col[i] for i in idx.tolist()]
+                # numpy integer indices subscript plain lists directly —
+                # no need to materialize idx as a Python list first.
+                sub[name] = [col[i] for i in idx]
         path = os.path.join(out_dir,
                             "part.{}.parquet_{}".format(part_id, int(b)))
         # Atomic publish (tmp + fsync + replace): a SIGKILLed worker can

@@ -469,6 +469,8 @@ def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
             return ("is_random_next: {} - [CLS] {} [SEP] {} [SEP] - "
                     "masked_lm_positions: {} - masked_lm_labels: {} - {}".format(
                         r["is_random_next"], r["A"], r["B"],
+                        # Human-readable debug sink only (never the
+                        # parquet path). -- lddl: disable=python-hot-loop
                         deserialize_np_array(r["masked_lm_positions"]).tolist(),
                         r["masked_lm_labels"], r["num_tokens"]))
         return "is_random_next: {} - [CLS] {} [SEP] {} [SEP] - {}".format(

@@ -109,7 +109,10 @@ def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
                         desc, attempt, elapsed, e),
                     getattr(e, "filename", None)) from e
             delay = min(cap, base * (2 ** (attempt - 1)))
-            delay *= _jitter_rng.uniform(0.5, 1.5)
+            # Backoff jitter only shapes WHEN a retry runs, never what any
+            # rank writes or reads — an unkeyed stream is the point here
+            # (keyed jitter would synchronize retry storms across ranks).
+            delay *= _jitter_rng.uniform(0.5, 1.5)  # lddl: disable=rng-flow
             delay = min(delay, max(0.0, deadline_s - elapsed))
             obs_inc("resilience_retry_attempts_total", op=op)
             obs_event("resilience.retry", op=op, attempt=attempt,

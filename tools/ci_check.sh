@@ -1,11 +1,34 @@
 #!/usr/bin/env bash
 # Fast static gate: the determinism/SPMD-safety analyzer plus a
 # whole-tree syntax pass (pyflakes when available, compileall otherwise).
-# Wired into tier-1 via tests/test_analysis.py::test_ci_check_script.
+#
+# Two modes:
+#   tools/ci_check.sh            pre-commit default: report findings only
+#                                for files changed vs git HEAD (the flow
+#                                analysis still spans the whole tree, and
+#                                the content-hash cache makes the warm run
+#                                sub-second)
+#   tools/ci_check.sh --full     the tier-1 CI gate (wired via
+#                                tests/test_analysis.py::test_ci_check_script):
+#                                full-tree report + lddl_check.sarif
+#                                artifact for code-review tooling
+#
+# Extra arguments after the mode flag pass through to tools.lddl_check.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
-python -m tools.lddl_check "$@"
+MODE="changed"
+if [ "${1:-}" = "--full" ]; then
+    MODE="full"
+    shift
+fi
+
+if [ "$MODE" = "full" ]; then
+    python -m tools.lddl_check --sarif lddl_check.sarif "$@"
+    echo "ci_check: SARIF artifact written to lddl_check.sarif"
+else
+    python -m tools.lddl_check --changed-only "$@"
+fi
 
 if python -c "import pyflakes" >/dev/null 2>&1; then
     python -m pyflakes lddl_tpu tools benchmarks
