@@ -225,7 +225,14 @@ def main():
         }
         print(payload["phases"]["loader"], flush=True)
 
-        # --- phase 5: 2-process multihost simulate on a slice -------------
+        # --- phase 5: N-process elastic work stealing with a host kill ----
+        # Replaces the old barrier-coupled 2-process --multihost simulate:
+        # the elastic claim loop needs no coordinator, any host may die
+        # mid-unit, and the survivors reclaim its work. One host IS
+        # SIGKILLed mid-gather (fault injector, dies holding a unit's
+        # lease); per-host units/steals come from the CLI's elastic
+        # summary lines, and byte-level integrity from the sample count
+        # matching the 1-process baseline.
         sim_corpus = os.path.join(tmp, "sim_corpus")
         if not os.path.isdir(sim_corpus):
             os.makedirs(os.path.join(sim_corpus, "source"))
@@ -234,40 +241,135 @@ def main():
                 shutil.copy(
                     os.path.join(corpus, "source", "{}.txt".format(i)),
                     os.path.join(sim_corpus, "source", "{}.txt".format(i)))
-        sim_out = os.path.join(tmp, "sim_pre")
+        sim_bytes = sum(
+            os.path.getsize(os.path.join(sim_corpus, "source", f))
+            for f in os.listdir(os.path.join(sim_corpus, "source")))
+
+        def elastic_cli(sink, holder):
+            return [sys.executable, "-m",
+                    "lddl_tpu.cli.preprocess_bert_pretrain",
+                    "--wikipedia", sim_corpus, "--sink", sink,
+                    "--vocab-file", vocab, "--masking", "--bin-size", "64",
+                    "--num-blocks", "64", "--seed", "99",
+                    "--sample-ratio", "0.9", "--local-workers", "1",
+                    "--elastic", "--lease-ttl", "10",
+                    "--elastic-host-id", holder]
+
+        def count_samples(sink):
+            n = 0
+            for name in sorted(os.listdir(sink)):
+                if ".parquet" in name and ".tmp." not in name:
+                    n += pq.read_metadata(os.path.join(sink, name)).num_rows
+            return n
+
+        # 5a: single-elastic-host baseline (the scaling denominator).
+        base_out = os.path.join(tmp, "sim_pre_1p")
         t0 = time.time()
-        procs = []
-        for rank in range(2):
+        rc = subprocess.run(elastic_cli(base_out, "base"),
+                            env=dict(_env(), JAX_PLATFORMS="cpu"),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.STDOUT).returncode
+        base_wall = time.time() - t0
+        assert rc == 0, "elastic 1-proc baseline failed rc={}".format(rc)
+        base_samples = count_samples(base_out)
+
+        # 5b: N hosts, one SIGKILLed at its first gather ledger publish.
+        # host0 (the victim) gets a head start so it is guaranteed to
+        # reach a gather publish even on a small slice where a fast
+        # sibling could otherwise drain the whole queue first; the
+        # survivors join the in-progress run via the fingerprint
+        # manifest and steal the unit host0 dies holding.
+        n_hosts = 3
+        sim_out = os.path.join(tmp, "sim_pre_np")
+        t0 = time.time()
+        # Host stdout goes to FILES, not pipes: an undrained 64KB pipe
+        # would block a chatty host mid-claim-loop (its keeper thread
+        # still renewing, so nothing could ever steal its units) and
+        # deadlock the whole phase.
+        log_paths = [os.path.join(tmp, "host{}.log".format(r))
+                     for r in range(n_hosts)]
+        log_files = [open(p, "w") for p in log_paths]
+        env0 = dict(_env(), JAX_PLATFORMS="cpu")
+        env0["LDDL_TPU_FAULTS"] = "replace:kill:nth=1:path=_done/group-"
+        procs = [subprocess.Popen(
+            elastic_cli(sim_out, "host0"), env=env0,
+            stdout=log_files[0], stderr=subprocess.STDOUT)]
+        sc_records = os.path.join(sim_out, "_done")
+        deadline = time.time() + 120
+        while time.time() < deadline and procs[0].poll() is None:
+            if os.path.isdir(sc_records) and any(
+                    n.startswith("scatter-")
+                    for n in os.listdir(sc_records)):
+                break  # host0 is mid-scatter: safely ahead
+            time.sleep(0.2)
+        for rank in range(1, n_hosts):
             procs.append(subprocess.Popen(
-                [sys.executable, "-m",
-                 "lddl_tpu.cli.preprocess_bert_pretrain",
-                 "--wikipedia", sim_corpus, "--sink", sim_out,
-                 "--vocab-file", vocab, "--masking", "--bin-size", "64",
-                 "--num-blocks", "64", "--seed", "99",
-                 "--sample-ratio", "0.9",
-                 "--multihost", "--coordinator-address", "127.0.0.1:12355",
-                 "--num-processes", "2", "--process-id", str(rank)],
+                elastic_cli(sim_out, "host{}".format(rank)),
                 env=dict(_env(), JAX_PLATFORMS="cpu"),
-                stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT))
-        rcs = [q.wait() for q in procs]
+                stdout=log_files[rank], stderr=subprocess.STDOUT))
+        for q in procs:
+            try:
+                q.wait(timeout=3600)
+            except subprocess.TimeoutExpired:
+                for p2 in procs:
+                    p2.kill()
+                raise RuntimeError("elastic phase host hung")
+        for f in log_files:
+            f.close()
+        host_logs = []
+        for p in log_paths:
+            with open(p) as f:
+                host_logs.append(f.read())
+        rcs = [q.returncode for q in procs]
         sim_wall = time.time() - t0
-        assert rcs == [0, 0], "simulate legs failed: {}".format(rcs)
-        sim_samples = 0
-        for name in sorted(os.listdir(sim_out)):
-            if ".parquet" in name:
-                sim_samples += pq.read_metadata(
-                    os.path.join(sim_out, name)).num_rows
-        payload["phases"]["multihost_simulate_2proc"] = {
-            "wall_s": round(sim_wall, 1), "samples": sim_samples,
+        assert rcs[0] == -signal.SIGKILL, \
+            "host0 was supposed to be SIGKILLed: rcs={}".format(rcs)
+        assert rcs[1:] == [0] * (n_hosts - 1), \
+            "survivor legs failed: {}".format(rcs)
+        sim_samples = count_samples(sim_out)
+        assert sim_samples == base_samples, \
+            "elastic N-proc output diverged: {} vs {}".format(
+                sim_samples, base_samples)
+
+        import re
+        per_host = {}
+        summary_re = re.compile(
+            r"elastic summary: holder=(\S+) units=(\d+) steals=(\d+) "
+            r"fence_rejects=(\d+)")
+        for rank, text in enumerate(host_logs):
+            m = summary_re.search(text or "")
+            per_host["host{}".format(rank)] = (
+                {"units_completed": int(m.group(2)),
+                 "steals": int(m.group(3)),
+                 "fence_rejects": int(m.group(4))}
+                if m else {"killed_mid_run": True})
+        mbps_1p = sim_bytes / 1024 / 1024 / max(base_wall, 1e-9)
+        mbps_np = sim_bytes / 1024 / 1024 / max(sim_wall, 1e-9)
+        payload["phases"]["elastic_worksteal"] = {
+            "hosts": n_hosts, "killed_host": "host0",
+            "wall_s_1proc": round(base_wall, 1),
+            "wall_s_nproc_with_kill": round(sim_wall, 1),
+            "samples": sim_samples,
+            "per_host": per_host,
+            "steals_total": sum(h.get("steals", 0)
+                                for h in per_host.values()),
+            "mb_per_s_1proc": round(mbps_1p, 2),
+            "mb_per_s_nproc": round(mbps_np, 2),
+            "scaling_ratio": round(mbps_np / max(mbps_1p, 1e-9), 2),
         }
-        print(payload["phases"]["multihost_simulate_2proc"], flush=True)
+        print(payload["phases"]["elastic_worksteal"], flush=True)
 
         payload["note"] = (
             "all phases through the real CLIs on a single host; preprocess "
             "leg 1 is SIGKILLed once ~1/3 of gather units are ledgered and "
             "the --resume leg finishes the run (spool reused: scatter "
-            "marker present). Peak RSS = VmHWM summed over the worker "
-            "tree, 1 s polling.")
+            "marker present). Phase 5 runs the lease-based elastic "
+            "work-stealing preprocess on a corpus slice: a 1-process "
+            "baseline, then N independent --elastic hosts with host0 "
+            "SIGKILLed at its first gather ledger publish (dies holding a "
+            "lease); survivors steal, finish, and the sample census must "
+            "match the baseline exactly. Peak RSS = VmHWM summed over the "
+            "worker tree, 1 s polling.")
         with open(os.path.join(ROOT, "SCALE_RUN.json"), "w") as f:
             json.dump(payload, f, indent=1)
         print("wrote SCALE_RUN.json")

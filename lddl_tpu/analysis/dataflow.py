@@ -1,6 +1,6 @@
-"""Interprocedural taint engine behind the four flow rules.
+"""Interprocedural taint engine behind the flow rules.
 
-Three taint *kinds* track values whose presence in pipeline output breaks
+Four taint *kinds* track values whose presence in pipeline output breaks
 the determinism contract (PAPER §0: byte-stable manifests, rank-identical
 RNG, FS-order-independent enumeration):
 
@@ -17,6 +17,16 @@ RNG, FS-order-independent enumeration):
     through ``sorted()`` / order-insensitive reductions; sinks are
     order-observing uses (iteration, indexing, string interpolation,
     error text, publish arguments).
+``lease``
+    scheduling state from :mod:`lddl_tpu.resilience.leases` (holder ids,
+    epochs, wall-clock deadlines). Sources are synthesized in phase B:
+    the return value of ANY call into the lease module is lease-tainted
+    (and counts as boundary-crossing by construction). Sinks: publish
+    arguments and manifest/ledger builder content — leases decide WHO
+    runs a unit, and nothing about the winner may reach shard bytes or
+    ``.manifest.json``. The lease module's own file writes are exempt
+    (lease files ARE lease state; they live in ``_leases/``, never a
+    shard directory).
 
 A fourth analysis is an *effect* propagation, not value taint:
 ``publish-path`` marks every function that transitively performs a raw
@@ -56,14 +66,21 @@ import ast
 
 # ------------------------------------------------------------ vocabulary
 
-KINDS = ("wallclock", "rng", "fsorder")
+KINDS = ("wallclock", "rng", "fsorder", "lease")
 
 RULE_ID_OF_KIND = {
     "wallclock": "wall-clock-flow",
     "rng": "rng-flow",
     "fsorder": "fs-order-flow",
+    "lease": "lease-isolation",
 }
 PUBLISH_PATH_RULE = "publish-path-flow"
+
+# The lease protocol module: calls into it yield lease-tainted values
+# (phase B synthesizes the source), and its OWN publish calls are not
+# shard publishes (lease files live under _leases/, deliberately written
+# with the atomic primitives but never part of the dataset).
+LEASE_MODULE = "lddl_tpu/resilience/leases.py"
 
 _WALLCLOCK_SOURCES = frozenset({
     "time.time", "time.time_ns", "time.localtime", "time.gmtime",
@@ -306,7 +323,7 @@ class _Extractor(object):
                 t = self.eval_expr(stmt.value, env)
                 self.facts.returns = _union(self.facts.returns, t)
                 if self._manifest_ctx:
-                    self._sink(["wallclock", "rng"],
+                    self._sink(["wallclock", "rng", "lease"],
                                "returned from manifest/ledger builder "
                                "{}()".format(self.facts.name),
                                stmt, t)
@@ -357,7 +374,7 @@ class _Extractor(object):
             # d[k] = v: taint the container; in manifest builders the
             # stored value is manifest content.
             if self._manifest_ctx:
-                self._sink(["wallclock", "rng"],
+                self._sink(["wallclock", "rng", "lease"],
                            "stored into manifest/ledger content in "
                            "{}()".format(self.facts.name), tgt, term)
             base = tgt.value
@@ -429,7 +446,7 @@ class _Extractor(object):
             parts += [self.eval_expr(v, env) for v in node.values]
             t = _union(*parts)
             if self._manifest_ctx and t:
-                self._sink(["wallclock", "rng"],
+                self._sink(["wallclock", "rng", "lease"],
                            "placed in manifest/ledger content in "
                            "{}()".format(self.facts.name), node, t)
             return t
@@ -510,8 +527,13 @@ class _Extractor(object):
             local_receiver = fi is None
 
         # Publish sinks fire regardless of whether the publisher resolves
-        # into the project (resilience.io) or not (fixtures, stubs).
-        if dotted is not None and not local_receiver:
+        # into the project (resilience.io) or not (fixtures, stubs). The
+        # lease module is exempt: its "publishes" are the lease files
+        # themselves (scheduling state under _leases/, not shard data),
+        # and flagging them would make every legitimate lease operation a
+        # caller-side finding.
+        if dotted is not None and not local_receiver \
+                and self.module.path != LEASE_MODULE:
             for suffix, positions in _PUBLISH_SINKS.items():
                 if dotted == suffix or dotted.endswith("." + suffix):
                     for pos in positions:
@@ -761,6 +783,16 @@ class Engine(object):
                     params |= sp
             elif tag == "call":
                 callee, args = atom[1], atom[2]
+                if kind == "lease":
+                    callee_ff = self.functions.get(callee)
+                    if callee_ff is not None \
+                            and callee_ff.path == LEASE_MODULE:
+                        # Synthesized source: anything returned by the
+                        # lease module IS lease state. Crossing is true by
+                        # construction (the value came out of leases.py).
+                        t = _Taint(callee.split(".")[-1], callee_ff.path,
+                                   atom[3], True, callee)
+                        out[t.key() + (True,)] = t
                 summ = self.summaries.get(callee)
                 if summ is None:
                     for sub_term in args:

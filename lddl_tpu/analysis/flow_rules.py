@@ -60,7 +60,12 @@ class WallClockFlowRule(FlowRule):
     allow = ("lddl_tpu/observability/*", "benchmarks/*",
              # tmp-file names embed the pid on purpose: the pre-publish
              # scratch name is never part of the published state.
-             "lddl_tpu/resilience/io.py")
+             "lddl_tpu/resilience/io.py",
+             # Lease deadlines/holder ids are wall-clock BY DESIGN (the
+             # one cross-host time base a shared FS offers); the
+             # lease-isolation rule — not this one — guards the boundary
+             # that matters: lease state never reaches shard bytes.
+             "lddl_tpu/resilience/leases.py")
 
 
 @register
@@ -93,8 +98,23 @@ class PublishPathFlowRule(FlowRule):
     allow = ("lddl_tpu/resilience/io.py",)
 
 
+@register
+class LeaseIsolationRule(FlowRule):
+    id = "lease-isolation"
+    doc = ("lease state (holder id, epoch, deadline) returned by "
+           "resilience.leases must never flow into shard bytes or "
+           ".manifest.json content — lease files themselves and the "
+           "_done fence records are the only sanctioned sinks (the "
+           "latter carry inline suppressions)")
+    # No blanket allowances: the lease module's internal writes are
+    # exempted at the engine level (dataflow.LEASE_MODULE), and the one
+    # legitimate epoch-into-record flow in preprocess/steal.py is a
+    # why-commented inline suppression.
+    allow = ()
+
+
 FLOW_RULE_IDS = ("wall-clock-flow", "rng-flow", "fs-order-flow",
-                 "publish-path-flow")
+                 "publish-path-flow", "lease-isolation")
 
 
 def run_flow_analysis(module_facts):

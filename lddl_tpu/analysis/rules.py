@@ -74,8 +74,10 @@ class WallClockRule(Rule):
            "decisions; observability timestamps and benchmarks are "
            "allowlisted, log-only uses carry inline suppressions")
     # Trace timestamps are the one legitimate wall-clock consumer;
-    # benchmarks measure wall time by definition.
-    allow = ("lddl_tpu/observability/*", "benchmarks/*")
+    # benchmarks measure wall time by definition; lease deadlines are
+    # wall-clock by design (lease-isolation guards what matters there).
+    allow = ("lddl_tpu/observability/*", "benchmarks/*",
+             "lddl_tpu/resilience/leases.py")
 
     def run(self, ctx):
         for node in ast.walk(ctx.tree):
@@ -414,6 +416,10 @@ class ManifestDeterminismRule(Rule):
     doc = ("functions that build .manifest.json / ledger content must not "
            "draw wall-clock, pids, uuids, or RNG — resume compares these "
            "bytes across runs and ranks")
+    # Lease records legitimately carry wall-clock deadlines and per-host
+    # ids; they are scheduling state under _leases/, never resume-compared
+    # content (the lease-isolation flow rule guards the real boundary).
+    allow = ("lddl_tpu/resilience/leases.py",)
 
     def run(self, ctx):
         for node in ast.walk(ctx.tree):

@@ -42,6 +42,44 @@ def attach_multihost_arg(parser):
                         help="this host's rank (with --coordinator-address)")
 
 
+def attach_elastic_args(parser):
+    parser.add_argument(
+        "--elastic", action="store_true",
+        help="lease-based work-stealing multi-host mode: launch this SAME "
+             "command on N independent hosts sharing --sink (no "
+             "coordinator, no barriers); hosts claim scatter/gather units "
+             "via lease files, any host may die mid-unit and be reclaimed "
+             "by the survivors, output is byte-identical to a single-host "
+             "run. Mutually exclusive with --multihost")
+    parser.add_argument(
+        "--lease-ttl", type=float, default=30.0, metavar="SECONDS",
+        help="elastic lease TTL: a dead host's in-flight unit is stolen "
+             "after at most this long; must exceed the renewal round-trip "
+             "on your shared filesystem (renewals run at ttl/3)")
+    parser.add_argument(
+        "--elastic-host-id", default=None,
+        help="stable holder id for lease files (default: auto "
+             "hostname-pid-nonce)")
+    parser.add_argument(
+        "--scatter-units", type=int, default=None,
+        help="elastic scatter work-unit count (block slices; default "
+             "min(blocks, max(16, blocks/16)))")
+
+
+def elastic_kwargs_of(args):
+    if getattr(args, "elastic", False) and getattr(args, "multihost", False):
+        raise SystemExit(
+            "--elastic and --multihost are mutually exclusive: elastic "
+            "hosts coordinate through lease files in the output dir, not "
+            "jax.distributed")
+    return {
+        "elastic": getattr(args, "elastic", False),
+        "lease_ttl": args.lease_ttl,
+        "holder_id": args.elastic_host_id,
+        "scatter_units": args.scatter_units,
+    }
+
+
 def communicator_of(args):
     from ..parallel.distributed import get_communicator
     if getattr(args, "multihost", False):

@@ -6,14 +6,16 @@ Reference parity: the ``preprocess_bart_pretrain`` console script
 
 from ..preprocess import BartPretrainConfig, run_bart_preprocess
 from ..utils.args import attach_bool_arg
-from .common import (attach_corpus_args, attach_multihost_arg,
-                     communicator_of, corpus_paths_of, make_parser)
+from .common import (attach_corpus_args, attach_elastic_args,
+                     attach_multihost_arg, communicator_of, corpus_paths_of,
+                     elastic_kwargs_of, make_parser)
 
 
 def attach_args(parser=None):
     parser = parser or make_parser(__doc__)
     attach_corpus_args(parser)
     attach_multihost_arg(parser)
+    attach_elastic_args(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True)
     parser.add_argument("--vocab-file", default=None,
                         help="emit schema-v2 token-id columns "
@@ -49,6 +51,7 @@ def attach_args(parser=None):
 def main(args=None):
     import os
     args = args if args is not None else attach_args().parse_args()
+    elastic_kwargs = elastic_kwargs_of(args)
     comm = communicator_of(args)
     tokenizer = None
     if args.vocab_file or args.tokenizer:
@@ -74,6 +77,7 @@ def main(args=None):
         spool_groups=args.spool_groups,
         resume=args.resume,
         tokenizer=tokenizer,
+        **elastic_kwargs,
     )
 
 

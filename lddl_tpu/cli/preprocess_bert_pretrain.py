@@ -8,14 +8,16 @@ the static-scheduled runner's (--num-blocks, --multihost) and the new
 
 from ..preprocess import BertPretrainConfig, get_tokenizer, run_bert_preprocess
 from ..utils.args import attach_bool_arg
-from .common import (attach_corpus_args, attach_multihost_arg,
-                     communicator_of, corpus_paths_of, make_parser)
+from .common import (attach_corpus_args, attach_elastic_args,
+                     attach_multihost_arg, communicator_of, corpus_paths_of,
+                     elastic_kwargs_of, make_parser)
 
 
 def attach_args(parser=None):
     parser = parser or make_parser(__doc__)
     attach_corpus_args(parser)
     attach_multihost_arg(parser)
+    attach_elastic_args(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True,
                         help="output directory for the parquet shards")
     parser.add_argument("--vocab-file", default=None)
@@ -73,6 +75,7 @@ def main(args=None):
     args = args if args is not None else attach_args().parse_args()
     if args.vocab_file is None and args.tokenizer is None:
         raise SystemExit("need --vocab-file or --tokenizer")
+    elastic_kwargs = elastic_kwargs_of(args)
     comm = communicator_of(args)
     tokenizer = get_tokenizer(vocab_file=args.vocab_file,
                               pretrained_model_name=args.tokenizer)
@@ -106,6 +109,7 @@ def main(args=None):
         log=print,
         spool_groups=args.spool_groups,
         resume=args.resume,
+        **elastic_kwargs,
     )
 
 

@@ -177,6 +177,10 @@ def run_bart_preprocess(
     resume=False,
     progress_interval=5.0,
     tokenizer=None,
+    elastic=False,
+    lease_ttl=30.0,
+    holder_id=None,
+    scatter_units=None,
 ):
     """Run the BART preprocessing pipeline (SPMD contract per
     run_sharded_pipeline). Output: part.<k>.parquet with a single
@@ -206,4 +210,8 @@ def run_bart_preprocess(
         spool_groups=spool_groups,
         resume=resume,
         progress_interval=progress_interval,
+        elastic=elastic,
+        lease_ttl=lease_ttl,
+        holder_id=holder_id,
+        scatter_units=scatter_units,
     )

@@ -38,6 +38,7 @@ spool rides the same medium the output does.
 
 import hashlib
 import json
+import logging
 import os
 import shutil
 import time
@@ -60,6 +61,8 @@ from . import binning as binning_mod
 _SPOOL_DIR = "_shuffle"
 _LEDGER_DIR = "_done"
 _SCATTER_MARKER = ".scatter_done"
+
+_log = logging.getLogger("lddl_tpu.preprocess.runner")
 
 
 class _Progress:
@@ -214,11 +217,22 @@ def _ledger_write(out_dir, group, written):
 
 
 def _ledger_read(out_dir, group):
-    try:
-        with open(_ledger_path(out_dir, group)) as f:
-            return json.load(f)
-    except (OSError, ValueError):
+    """One group's completion record, or None when the unit is not done.
+
+    Reads ride ``resilience.io.read_bytes`` (transient EIO/ESTALE on
+    NFS-like mounts retry with backoff instead of silently reading as
+    "not done" and redoing a finished unit). A torn/empty record — a
+    crash can only leave none-or-whole files through atomic_write, but
+    flaky storage can still serve torn bytes — degrades to "unit not
+    done" with a warning rather than crashing the resume."""
+    path = _ledger_path(out_dir, group)
+    rec, status = rio.read_json(path)
+    if status == "torn":
+        _log.warning("torn/unparseable ledger record %s (%d bytes); "
+                     "treating unit as not done (it will be redone)",
+                     path, len(rec))
         return None
+    return rec
 
 
 def _bucket_of(seed, block_id, doc_ordinal, nbuckets):
@@ -287,23 +301,26 @@ def _buckets_of_group(group, nbuckets, ngroups):
 
 
 def _spool_one_block(block, out_dir, seed, sample_ratio, nbuckets, ngroups,
-                     writer_tag):
+                     spool_name):
     """Scatter one input block: buffer every doc per (coarse group, fine
     bucket) — a block is a bounded slice of the corpus, ~corpus/nblocks
     bytes — then append each group's lines to THIS writer's exclusive
-    spool file. A "#B <block> <bucket>" header line precedes each run of
-    document lines (written as " " + text), so the gather pays no per-line
-    field parsing and the scatter never copies text bytes into a tagged
-    string (the round-3 per-line "<bucket> <block> <doc_id> <text>"
-    format cost ~8% of end-to-end preprocess throughput — VERDICT.md
-    round 3, item 1)."""
+    spool file ``spool_name`` (``w<rank>-<pid>.txt`` for the static
+    scheduler; the elastic scheduler names files per claim attempt,
+    ``s<slice>.e<epoch>.<holder>.txt``, so a reclaimed unit's debris is
+    sweepable and a zombie's late appends are fenced out by name). A
+    "#B <block> <bucket>" header line precedes each run of document lines
+    (written as " " + text), so the gather pays no per-line field parsing
+    and the scatter never copies text bytes into a tagged string (the
+    round-3 per-line "<bucket> <block> <doc_id> <text>" format cost ~8%
+    of end-to-end preprocess throughput — VERDICT.md round 3, item 1)."""
     with obs.span("preprocess.scatter_block", block=block.block_id):
         _spool_one_block_inner(block, out_dir, seed, sample_ratio, nbuckets,
-                               ngroups, writer_tag)
+                               ngroups, spool_name)
 
 
 def _spool_one_block_inner(block, out_dir, seed, sample_ratio, nbuckets,
-                           ngroups, writer_tag):
+                           ngroups, spool_name):
     by_group = {}
     ndocs = nbytes = 0
     for ordinal, (doc_id, text) in enumerate(
@@ -333,12 +350,11 @@ def _spool_one_block_inner(block, out_dir, seed, sample_ratio, nbuckets,
         # streams, so only the OPEN retries on transient errors — a
         # half-applied writelines is handled at the unit level (the
         # unmarked spool is wiped and redone on resume).
-        with rio.open_append(
-                os.path.join(group_dir, "w{}.txt".format(writer_tag))) as f:
+        with rio.open_append(os.path.join(group_dir, spool_name)) as f:
             f.writelines(parts)
 
 
-def _read_group_texts(out_dir, group, nbuckets, ngroups):
+def _read_group_texts(out_dir, group, nbuckets, ngroups, accept=None):
     """Read one coarse spool group once; return {bucket: [texts]} with each
     bucket's texts in canonical order: blocks sorted by block id as a
     STRING. (Lex order over digit strings matches the round-2 layout's
@@ -346,12 +362,20 @@ def _read_group_texts(out_dir, group, nbuckets, ngroups):
     pinned by tests/golden_spool.json.) Within a block, scatter wrote lines
     in document order under one "#B" header in one writer's file, so
     collecting per (bucket, block) and walking blocks in sorted order
-    preserves it regardless of how blocks were dealt to writers."""
+    preserves it regardless of how blocks were dealt to writers.
+
+    ``accept``: optional collection of exact file names to read — the
+    elastic scheduler's epoch fence: only the spool files named by each
+    scatter unit's completion record (the winning (epoch, holder) attempt)
+    are trusted; a fenced-off zombie's late appends land in files this
+    set never names."""
     group_dir = os.path.join(out_dir, _SPOOL_DIR, "group-{}".format(group))
     by_bucket = {b: {} for b in _buckets_of_group(group, nbuckets, ngroups)}
     if not os.path.isdir(group_dir):
         return {b: [] for b in by_bucket}
     for name in sorted(os.listdir(group_dir)):
+        if accept is not None and name not in accept:
+            continue
         # Bulk binary read + one C-level split: no per-line decode, no
         # per-line iterator overhead. Document bytes stay bytes all the
         # way into the C++ engine. Block keys stay BYTES digit strings —
@@ -520,9 +544,12 @@ def _record_bucket_written(written, bucket):
     obs.observe("preprocess_bucket_samples", total)
 
 
-def _run_block_bucket(spec, process_bucket, bucket):
+def _run_block_bucket(spec, process_bucket, bucket, fence=None):
     """No-global-shuffle unit: bucket == block; re-read the block directly
-    (texts never cross the process boundary)."""
+    (texts never cross the process boundary). ``fence`` (elastic mode):
+    checked after reading and before writing — a holder whose lease was
+    stolen self-terminates instead of publishing from possibly-stale
+    state."""
     input_files = discover_source_files(spec["corpus_paths"])
     blocks = plan_blocks(input_files, spec["num_blocks"])
     texts = [text for _, text in read_documents(
@@ -530,10 +557,23 @@ def _run_block_bucket(spec, process_bucket, bucket):
         base_seed=spec["seed"])]
     if spec.get("clean_first"):
         _clean_bucket_outputs(spec["out_dir"], bucket)
+    _check_fence(fence, bucket)
     with obs.span("preprocess.process_block", bucket=bucket):
         written = process_bucket(texts, bucket)
     _record_bucket_written(written, bucket)
     return written
+
+
+def _check_fence(fence, unit):
+    """Raise LeaseLost when an elastic unit's lease was stolen mid-run.
+    Placed between a unit's read step and its writes: once a steal has
+    happened, anything read afterwards may be concurrently swept or
+    finalized away, so the loser must never publish bytes derived from
+    it (the claim loop converts the raise into a fence-reject)."""
+    if fence is not None and not fence():
+        from ..resilience.leases import LeaseLost
+        raise LeaseLost(
+            "unit {} was stolen mid-run; self-terminating".format(unit))
 
 
 def _pool_run_block_bucket(bucket):
@@ -550,15 +590,19 @@ def _clean_bucket_outputs(out_dir, bucket):
             os.remove(path)
 
 
-def _run_group(spec, process_bucket, group):
-    """Gather unit: read one coarse spool group, process each fine bucket."""
+def _run_group(spec, process_bucket, group, fence=None):
+    """Gather unit: read one coarse spool group, process each fine bucket.
+    ``fence`` (elastic mode) is checked after the spool read and before
+    every bucket's writes — see `_check_fence`."""
     with obs.span("preprocess.gather_group", group=group):
         texts_by_bucket = _read_group_texts(spec["out_dir"], group,
-                                            spec["nbuckets"], spec["ngroups"])
+                                            spec["nbuckets"], spec["ngroups"],
+                                            accept=spec.get("spool_accept"))
         written = {}
         for bucket in sorted(texts_by_bucket):
             if spec.get("clean_first"):
                 _clean_bucket_outputs(spec["out_dir"], bucket)
+            _check_fence(fence, group)
             bucket_written = process_bucket(texts_by_bucket[bucket], bucket)
             _record_bucket_written(bucket_written, bucket)
             written.update(bucket_written)
@@ -575,7 +619,7 @@ def _pool_scatter_block(block_id):
     blocks = plan_blocks(input_files, spec["num_blocks"])
     _spool_one_block(blocks[block_id], spec["out_dir"], spec["seed"],
                      spec["sample_ratio"], len(blocks), spec["ngroups"],
-                     "{}-{}".format(spec["rank"], os.getpid()))
+                     "w{}-{}.txt".format(spec["rank"], os.getpid()))
     return block_id
 
 
@@ -593,12 +637,30 @@ def run_sharded_pipeline(
     spool_groups=None,
     resume=False,
     progress_interval=5.0,
+    elastic=False,
+    lease_ttl=30.0,
+    holder_id=None,
+    scatter_units=None,
 ):
     """Generic SPMD scaffolding shared by every preprocessor: dirty-dir
     guard -> block planning -> (optional) scatter shuffle -> strided bucket
     processing via ``process_bucket(texts, bucket) -> {path: n}`` ->
     cleanup + reduced totals. ``spool_groups`` overrides the coarse radix
     width (default min(nblocks, max(64, nblocks // 8))).
+
+    ``elastic=True`` replaces the static rank->unit schedule with the
+    lease-based work-stealing claim loop (:mod:`.steal`): launch the SAME
+    invocation on N independent host processes sharing ``out_dir`` (no
+    jax.distributed, no barriers — hosts may join late, die mid-unit, and
+    be reclaimed by the survivors; the last host out runs the
+    lease-guarded finalization). ``lease_ttl`` is the steal horizon in
+    seconds (a dead host's units are reclaimed after at most one TTL),
+    ``holder_id`` names this host in lease files (default: auto
+    hostname-pid-nonce), ``scatter_units`` overrides the scatter
+    work-unit count (block slices; default min(blocks, max(16,
+    blocks/16))). Output bytes are identical to a static single-host run
+    of the same plan — leases decide only WHO runs a unit, never what it
+    produces.
 
     Fault model: a unit (spool group / block) whose processing raises is
     recorded and skipped; a dead pool worker rebuilds the pool and retries.
@@ -619,27 +681,53 @@ def run_sharded_pipeline(
     """
     comm = comm or LocalCommunicator()
     log = log or (lambda msg: None)
+    if elastic and comm.world_size > 1:
+        raise ValueError(
+            "elastic mode replaces the static multihost schedule; launch "
+            "independent processes sharing the output dir instead of "
+            "initializing jax.distributed (--multihost)")
     # Top-level stage span (lint-enforced: tests/test_observability.py);
     # scatter/gather phases and per-unit worker spans nest under it in
     # the per-process trace files.
     with obs.span("preprocess.run", rank=comm.rank,
-                  world_size=comm.world_size):
+                  world_size=comm.world_size, elastic=bool(elastic)):
         try:
             return _run_pipeline_body(
                 corpus_paths, out_dir, process_bucket, num_blocks,
                 sample_ratio, seed, global_shuffle, comm, log, num_workers,
-                spool_groups, resume, progress_interval)
+                spool_groups, resume, progress_interval, elastic,
+                lease_ttl, holder_id, scatter_units)
         finally:
             obs.flush()
 
 
 def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
                        sample_ratio, seed, global_shuffle, comm, log,
-                       num_workers, spool_groups, resume, progress_interval):
+                       num_workers, spool_groups, resume, progress_interval,
+                       elastic=False, lease_ttl=30.0, holder_id=None,
+                       scatter_units=None):
     # Refuse a dirty output dir (unless resuming): stale part files from a
     # previous run with a different block count would silently survive next
-    # to fresh ones and duplicate data downstream.
-    if os.path.isdir(out_dir) and not resume:
+    # to fresh ones and duplicate data downstream. Elastic hosts joining a
+    # run already in progress are the exception: the ledger manifest below
+    # proves the directory belongs to THIS plan (a fingerprint mismatch
+    # still refuses loudly).
+    manifest_path = os.path.join(out_dir, _LEDGER_DIR, "manifest.json")
+    joining = elastic and os.path.exists(manifest_path)
+    if elastic and not joining and os.path.isdir(out_dir):
+        # Simultaneous elastic starts race the first host's manifest
+        # publish: its _done/_leases dirs can exist for a moment before
+        # manifest.json lands. Wait briefly before judging the directory
+        # dirty — a genuinely stale dir still refuses, just 10s later.
+        from ..resilience.leases import LEASE_DIR
+        if any(os.path.isdir(os.path.join(out_dir, d))
+               for d in (_LEDGER_DIR, LEASE_DIR)):
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline \
+                    and not os.path.exists(manifest_path):
+                time.sleep(0.1)
+            joining = os.path.exists(manifest_path)
+    if os.path.isdir(out_dir) and not resume and not joining:
         stale = [
             n for n in sorted(os.listdir(out_dir))
             if ".parquet" in n or (".txt" in n and not n.startswith("."))
@@ -666,19 +754,35 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
     log("{} input files -> {} blocks ({} spool groups)".format(
         len(input_files), len(blocks), ngroups))
     proc_fp = getattr(process_bucket, "fingerprint", None)
-    _check_resume_manifest(
-        out_dir,
-        {"num_blocks": nbuckets, "spool_groups": ngroups, "seed": seed,
-         "sample_ratio": sample_ratio, "global_shuffle": global_shuffle,
-         # Unit identity is not enough: the corpus and the processor's
-         # own parameters (vocab, binning, masking, sink format) also
-         # define what a ledgered unit's bytes MEAN (ADVICE round 3).
-         # Paths absolutize so a resume launched from a different cwd
-         # (relative vs absolute spelling) is not spuriously refused.
-         "corpus_paths": json.dumps(
-             _canonical_paths(corpus_paths), sort_keys=True, default=str),
-         "processor": proc_fp() if callable(proc_fp) else None},
-        resume, comm.rank)
+    fingerprint = {
+        "num_blocks": nbuckets, "spool_groups": ngroups, "seed": seed,
+        "sample_ratio": sample_ratio, "global_shuffle": global_shuffle,
+        # Unit identity is not enough: the corpus and the processor's
+        # own parameters (vocab, binning, masking, sink format) also
+        # define what a ledgered unit's bytes MEAN (ADVICE round 3).
+        # Paths absolutize so a resume launched from a different cwd
+        # (relative vs absolute spelling) is not spuriously refused.
+        "corpus_paths": json.dumps(
+            _canonical_paths(corpus_paths), sort_keys=True, default=str),
+        "processor": proc_fp() if callable(proc_fp) else None,
+    }
+    n_scatter_units = None
+    if elastic:
+        # The elastic unit plan (scatter slices, per-slice records, fenced
+        # spool file names) is incompatible with the static layout and
+        # with a different slice count — both are part of unit identity,
+        # so mixing them across a resume must refuse.
+        n_scatter_units = (min(nbuckets, max(16, nbuckets // 16))
+                          if scatter_units is None
+                          else max(1, min(int(scatter_units), nbuckets)))
+        fingerprint["elastic"] = True
+        fingerprint["scatter_units"] = n_scatter_units
+    # An elastic host joining an in-progress run verifies against the
+    # existing manifest exactly like a resume would (hosts start at
+    # different times by design; a misconfigured straggler must refuse,
+    # not corrupt).
+    _check_resume_manifest(out_dir, fingerprint,
+                           resume or (elastic and joining), comm.rank)
     comm.barrier()  # manifest visible before anyone journals against it
 
     # Intra-host fan-out (the reference runs ~128 MPI ranks per node,
@@ -700,6 +804,14 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
         "ngroups": ngroups,
         "rank": comm.rank,
     }
+
+    if elastic:
+        spec["scatter_units"] = n_scatter_units
+        from . import steal
+        return steal.run_elastic_pipeline(
+            spec, process_bucket, log,
+            holder_id=holder_id, lease_ttl=lease_ttl, workers=workers,
+            progress_interval=progress_interval, t0=t0)
 
     def pool_factory_for(n_units):
         if workers <= 1 or n_units <= 1:
@@ -750,7 +862,7 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
             comm.barrier()
             my_blocks = list(range(comm.rank, len(blocks), comm.world_size))
             factory = pool_factory_for(len(my_blocks))
-            serial_tag = "{}-0".format(comm.rank)
+            serial_name = "w{}-0.txt".format(comm.rank)
             # retry_deaths=False: a dead scatter worker leaves partial
             # appends that a re-run would duplicate; the only safe redo is
             # wiping the (unmarked) spool, which the next resume does.
@@ -760,7 +872,7 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
                     _pool_scatter_block if factory else
                     (lambda b: _spool_one_block(
                         blocks[b], out_dir, seed, sample_ratio, nbuckets,
-                        ngroups, serial_tag)),
+                        ngroups, serial_name)),
                     my_blocks, factory, log,
                     "rank {} scatter".format(comm.rank), retry_deaths=False,
                     progress_interval=progress_interval)
@@ -821,23 +933,7 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
             shutil.rmtree(os.path.join(out_dir, _SPOOL_DIR),
                           ignore_errors=True)
         shutil.rmtree(os.path.join(out_dir, _LEDGER_DIR), ignore_errors=True)
-        # Sweep atomic-write temp files leaked by hard-killed writers: a
-        # worker terminated mid-write (its own SIGKILL, or the pool
-        # tearing down siblings after a break) never runs the unlink in
-        # write_table_atomic's finally, and if its unit was completed by
-        # a retry within the same run the ledger marks it done — so no
-        # resume ever redoes (and cleans) that bucket. After the final
-        # barrier every live write has published; any remaining
-        # ``*.tmp.<pid>`` is debris by construction.
-        import glob
-        for stale in sorted(glob.glob(os.path.join(out_dir, "*.tmp.*"))):
-            try:
-                os.remove(stale)
-                obs.inc("preprocess_stale_tmp_cleaned_total")
-            # Best-effort sweep of dead writers' debris: a vanished or
-            # unremovable temp file must not fail a completed run.
-            except OSError:  # lddl: disable=swallowed-error
-                pass
+        _sweep_tmp_debris(out_dir)
     totals = comm.allreduce_sum([len(written), sum(written.values())])
     elapsed = time.time() - t0  # lddl: disable=wall-clock (log-only rates)
     if obs.enabled():
@@ -853,6 +949,27 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
     log("preprocess done in {:.1f}s, {} shards, {} samples".format(
         elapsed, int(totals[0]), int(totals[1])))
     return written
+
+
+def _sweep_tmp_debris(out_dir):
+    """Sweep atomic-write temp files leaked by hard-killed writers: a
+    worker terminated mid-write (its own SIGKILL, or the pool tearing
+    down siblings after a break) never runs the unlink in
+    write_table_atomic's finally, and if its unit was completed by a
+    retry within the same run the ledger marks it done — so no resume
+    ever redoes (and cleans) that bucket. Called only after every live
+    write has published (post-barrier on the static path, inside the
+    finalize lease on the elastic path); any remaining ``*.tmp.*`` is
+    debris by construction."""
+    import glob
+    for stale in sorted(glob.glob(os.path.join(out_dir, "*.tmp.*"))):
+        try:
+            os.remove(stale)
+            obs.inc("preprocess_stale_tmp_cleaned_total")
+        # Best-effort sweep of dead writers' debris: a vanished or
+        # unremovable temp file must not fail a completed run.
+        except OSError:  # lddl: disable=swallowed-error
+            pass
 
 
 def train_splitter_params_from_corpus(corpus_paths, sample_bytes=1_500_000):
@@ -896,6 +1013,10 @@ def run_bert_preprocess(
     spool_groups=None,
     resume=False,
     progress_interval=5.0,
+    elastic=False,
+    lease_ttl=30.0,
+    holder_id=None,
+    scatter_units=None,
 ):
     """Run the full BERT preprocessing pipeline (see run_sharded_pipeline
     for the SPMD execution contract). ``num_workers`` > 1 fans the bucket
@@ -903,7 +1024,9 @@ def run_bert_preprocess(
     from a script (rather than the CLI), guard the call with
     ``if __name__ == "__main__":`` or spawn re-executes your module
     (standard multiprocessing semantics). ``resume=True`` continues a
-    crashed/failed run from its unit ledger."""
+    crashed/failed run from its unit ledger. ``elastic=True`` runs the
+    lease-based work-stealing schedule instead of the static one (see
+    run_sharded_pipeline)."""
     config = config or BertPretrainConfig()
     if output_format not in ("parquet", "txt"):
         raise ValueError("output_format must be parquet|txt")
@@ -928,4 +1051,8 @@ def run_bert_preprocess(
         spool_groups=spool_groups,
         resume=resume,
         progress_interval=progress_interval,
+        elastic=elastic,
+        lease_ttl=lease_ttl,
+        holder_id=holder_id,
+        scatter_units=scatter_units,
     )

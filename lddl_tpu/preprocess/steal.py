@@ -1,0 +1,813 @@
+"""Elastic multi-host preprocessing: the lease-fenced work-stealing loop.
+
+The static runner (:mod:`.runner`) schedules units by rank striding and
+meets at barriers — one dead host wedges the phase (MPI semantics, exactly
+what the reference inherits from dask-mpi). This module replaces the
+schedule with a **claim loop** over the same units: N independent host
+processes — no jax.distributed, no barriers, nothing shared but the
+output directory — each repeatedly
+
+    1. pick a unit whose completion record is absent,
+    2. claim it via an atomic-rename lease (:mod:`..resilience.leases`),
+    3. sweep any previous attempt's partial outputs,
+    4. run it (serially or on the host's local spawn pool),
+    5. fence-check the lease and, only if still held at the claimed
+       epoch, journal the completion record,
+
+until every unit is journaled. A host that dies mid-unit simply stops
+renewing its lease; after one TTL any survivor steals the unit (epoch
+bump), sweeps the debris, and redoes it. A host that *stalls* and
+resurrects after a steal fails the fence check and discards its late
+result (``lease_fence_rejects_total``) — the ledger only ever sees one
+winner per unit.
+
+Determinism contract: a unit's output bytes are a pure function of the
+resume fingerprint and the unit id (PR 1/4 machinery). Leases decide WHO
+runs a unit, never what it produces, so an elastic run of any host count,
+with any sequence of host deaths, is byte-identical to a static
+single-host run of the same plan (chaos-pinned in tests/test_chaos.py).
+
+Unit kinds and their fencing:
+
+- **scatter slices** (blocks ``unit, unit+S, ...`` of the plan): spool
+  appends are not idempotent, so every claim attempt writes its own
+  exclusively-owned files ``group-<g>/s<slice>.e<epoch>.<holder>.txt``
+  and the completion record stores the winning ``(epoch, holder)``. The
+  gather trusts ONLY the recorded file names — a fenced-off zombie's late
+  appends land in files nothing ever reads.
+- **gather groups** (coarse spool groups) / **blocks** (no-shuffle mode):
+  outputs are whole shard files published atomically under deterministic
+  names, so a zombie rewriting them is byte-identical by construction;
+  the fence protects the ledger record itself.
+- **finalize** (manifest + cleanup) is itself a lease-guarded unit — the
+  last host out runs it, and if it dies mid-finalize a survivor steals
+  that too. The lease directory is removed last: its disappearance is
+  the "run complete" signal waiting hosts poll for.
+"""
+
+import concurrent.futures as cf
+import hashlib
+import json
+import logging
+import os
+import shutil
+import time
+
+from .. import observability as obs
+from ..parallel.distributed import LocalCommunicator
+from ..resilience import io as rio
+from ..resilience import leases
+from . import runner as _runner
+
+_FINALIZE_UNIT = "finalize"
+_SCATTER_PREFIX = "scatter-"
+_GROUP_PREFIX = "group-"
+_BLOCK_PREFIX = "block-"
+
+_log = logging.getLogger("lddl_tpu.preprocess.steal")
+
+
+def _fence_for(out_dir, prefix, unit, epoch, holder):
+    """A zero-state fence closure for unit bodies (works across the pool
+    process boundary: everything needed to re-check the lease travels as
+    plain values). False once the unit's lease stops naming exactly this
+    (holder, epoch) attempt."""
+    root = leases.lease_root(out_dir)
+    key = "{}{}".format(prefix, unit)
+    return lambda: leases.verify_at(root, key, holder, epoch)
+
+
+# ------------------------------------------------------------ unit records
+
+
+def _scatter_record_path(out_dir, unit):
+    return os.path.join(out_dir, _runner._LEDGER_DIR,
+                        "scatter-{}.json".format(unit))
+
+
+def _read_scatter_record(out_dir, unit):
+    """A scatter slice's completion record ({"epoch", "holder"}), or None.
+    Torn bytes degrade to "not done" with a warning, like `_ledger_read`."""
+    rec, status = rio.read_json(_scatter_record_path(out_dir, unit))
+    if status == "torn":
+        _log.warning("torn scatter record for unit %s; treating as not "
+                     "done", unit)
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def _publish_scatter_record(out_dir, unit, lease):
+    """Journal a completed scatter slice. The record IS the epoch fence
+    for spool bytes: it names the one (epoch, holder) attempt whose files
+    the gather may read — so lease state flowing into this _done record
+    is the design, not a leak (it never reaches shard bytes or
+    .manifest.json; the analyzer's lease-isolation rule guards those)."""
+    path = _scatter_record_path(out_dir, unit)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = json.dumps({"epoch": lease.epoch, "holder": lease.holder},
+                         sort_keys=True)
+    # Fence record by design (see docstring): epoch+holder, wall-clock-free.
+    rio.atomic_write(path, payload)  # lddl: disable=lease-isolation,wall-clock-flow
+    # Post-publish fence re-check: if the lease was stolen in the tiny
+    # window between the pre-publish verify and this write, the thief may
+    # ALREADY have journaled its own record — which our stale write just
+    # clobbered with file names the thief swept. Re-read: if the record on
+    # disk is ours but the lease is not, withdraw it so the unit is redone
+    # rather than pointing at deleted spool files.
+    if not leases.verify(lease):
+        cur = _read_scatter_record(out_dir, unit)
+        if cur == {"epoch": lease.epoch, "holder": lease.holder}:
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
+        _prune_empty_scaffolding(out_dir)
+        return False
+    return True
+
+
+def _prune_empty_scaffolding(out_dir):
+    """Best-effort removal of `_done`/`_leases` dirs a zombie's late write
+    resurrected AFTER finalize retired them (os.makedirs inside the
+    publish/acquire paths recreates the dir). rmdir only succeeds on
+    empty dirs, so a live run's scaffolding is never touched."""
+    for d in (os.path.join(out_dir, _runner._LEDGER_DIR),
+              leases.lease_root(out_dir)):
+        try:
+            os.rmdir(d)
+        # Non-empty (live run) or already gone: both fine by design.
+        except OSError:  # lddl: disable=swallowed-error
+            pass
+
+
+def _publish_gather_record(out_dir, unit, result, lease):
+    """Journal a completed gather unit, with the same post-publish fence
+    re-check the scatter path has: if the lease was lost in the window
+    between the claim loop's verify and this write, the record is
+    withdrawn — a stalled zombie must not resurrect `_done/` inside an
+    already-finalized output dir (and in the live-thief case a withdrawn
+    record merely makes the unit's owner republish identical bytes)."""
+    _runner._ledger_write(out_dir, unit, result)
+    if not leases.verify(lease):
+        try:
+            os.remove(_runner._ledger_path(out_dir, unit))
+        except FileNotFoundError:
+            pass
+        _prune_empty_scaffolding(out_dir)
+        return False
+    return True
+
+
+def spool_name(unit, epoch, holder):
+    """The exclusive spool file name of one scatter claim attempt (per
+    coarse group). Epoch+holder make every attempt's files disjoint."""
+    return "s{}.e{}.{}.txt".format(unit, epoch, holder)
+
+
+def _stable_scatter_records(out_dir, scatter_units, lease_root, ttl, poll):
+    """Read every scatter record until two consecutive sweeps agree.
+
+    Returns ``("ok", {unit: record})``, ``("finalized", None)`` when
+    another host already finalized the whole run, or ``("retry", None)``
+    when a record is missing with no live lease — a fenced loser's
+    clobber-then-withdraw transiently un-journaled the unit and the
+    withdrawer died before redoing it, so the caller must re-enter the
+    claim loop. The double read closes the window in which an accept set
+    built from a loser's transient record would name spool files the
+    winner's sweep deleted; what remains requires two suspensions at
+    exactly the wrong microseconds AND is still bounded by this
+    function's own re-read."""
+    ledger_dir = os.path.join(out_dir, _runner._LEDGER_DIR)
+    patience = max(2.0 * ttl, 3.0)
+    deadline = time.monotonic() + patience
+    prev = None
+    while True:
+        if not os.path.isdir(ledger_dir):
+            return "finalized", None
+        recs = {}
+        missing = None
+        for u in scatter_units:
+            rec = _read_scatter_record(out_dir, u)
+            if rec is None:
+                missing = u
+                break
+            recs[u] = rec
+        if missing is None:
+            if recs == prev:
+                return "ok", recs
+            prev = recs
+            time.sleep(min(poll, 0.05))
+            continue
+        prev = None
+        if leases.is_live(lease_root,
+                          "{}{}".format(_SCATTER_PREFIX, missing)):
+            # Someone is actively republishing/redoing it: keep waiting.
+            deadline = time.monotonic() + patience
+        elif time.monotonic() >= deadline:
+            return "retry", None
+        time.sleep(poll)
+
+
+# -------------------------------------------------------------- unit tasks
+#
+# Module-level so spawn pools can pickle them; serial mode calls them
+# directly via closures built in run_elastic_pipeline. All take
+# (unit, epoch, holder) so the claimed attempt's identity reaches the
+# spool file names.
+
+
+def _scatter_slice(spec, unit, epoch, holder):
+    """Scatter all blocks of one slice (``unit, unit+S, ...``) into this
+    attempt's exclusive spool files, self-terminating between blocks if
+    the lease is stolen (appends after a steal would only be debris —
+    fenced out by name — but stopping early keeps the thief's sweep
+    meaningful and the host honest)."""
+    input_files = _runner.discover_source_files(spec["corpus_paths"])
+    blocks = _runner.plan_blocks(input_files, spec["num_blocks"])
+    name = spool_name(unit, epoch, holder)
+    fence = _fence_for(spec["out_dir"], _SCATTER_PREFIX, unit, epoch, holder)
+    n = 0
+    for b in range(unit, len(blocks), spec["scatter_units"]):
+        _runner._check_fence(fence, unit)
+        _runner._spool_one_block(blocks[b], spec["out_dir"], spec["seed"],
+                                 spec["sample_ratio"], len(blocks),
+                                 spec["ngroups"], name)
+        n += 1
+    return n
+
+
+def _pool_scatter_slice(unit, epoch, holder):
+    return _scatter_slice(_runner._POOL["spec"], unit, epoch, holder)
+
+
+def _pool_gather_group(unit, epoch, holder):
+    spec = _runner._POOL["spec"]
+    return _runner._run_group(
+        spec, _runner._POOL["process_bucket"], unit,
+        fence=_fence_for(spec["out_dir"], _GROUP_PREFIX, unit, epoch,
+                         holder))
+
+
+def _pool_block_bucket(unit, epoch, holder):
+    spec = _runner._POOL["spec"]
+    return _runner._run_block_bucket(
+        spec, _runner._POOL["process_bucket"], unit,
+        fence=_fence_for(spec["out_dir"], _BLOCK_PREFIX, unit, epoch,
+                         holder))
+
+
+# ------------------------------------------------------------------ sweeps
+
+
+def _sweep_scatter(spec, unit):
+    """Remove EVERY attempt's spool files for a reclaimed scatter slice
+    (all epochs/holders: only the attempt about to run may have files)."""
+    import glob
+    pattern = os.path.join(spec["out_dir"], _runner._SPOOL_DIR, "group-*",
+                           "s{}.e*".format(unit))
+    n = 0
+    for path in sorted(glob.glob(pattern)):
+        try:
+            os.remove(path)
+            n += 1
+        except FileNotFoundError:
+            pass
+    if n:
+        obs.inc("elastic_swept_files_total", int(n))
+    return n
+
+
+def _sweep_gather(spec, unit):
+    """Remove a reclaimed gather group's partial bucket outputs (final
+    part files AND ``*.tmp.*`` atomic-write debris — the exact-prefix
+    globs in `_clean_bucket_outputs` cover both)."""
+    for bucket in _runner._buckets_of_group(unit, spec["nbuckets"],
+                                            spec["ngroups"]):
+        _runner._clean_bucket_outputs(spec["out_dir"], bucket)
+
+
+def _sweep_block(spec, unit):
+    _runner._clean_bucket_outputs(spec["out_dir"], unit)
+
+
+# -------------------------------------------------------------- claim loop
+
+
+class _InlineExecutor(object):
+    """Executor shim for serial hosts: submit() runs the task inline and
+    returns an already-settled Future, so the claim loop has one shape."""
+
+    def submit(self, fn, *args):
+        fut = cf.Future()
+        try:
+            fut.set_result(fn(*args))
+        except BaseException as e:  # noqa: BLE001 - future carries it
+            fut.set_exception(e)
+        return fut
+
+    def shutdown(self, wait=True):
+        pass
+
+
+def _rotated(units, holder):
+    """Deterministic per-holder rotation of the unit scan order, so N
+    hosts starting together fan out across the unit space instead of
+    racing for unit 0. Pure scheduling: never shapes output bytes."""
+    order = sorted(units)
+    if not order:
+        return order
+    start = int.from_bytes(
+        hashlib.blake2b(holder.encode(), digest_size=4).digest(),
+        "little") % len(order)
+    return order[start:] + order[:start]
+
+
+def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
+               is_done, sweep, task, publish, executor_factory, max_inflight,
+               log, progress_interval=5.0, poll_s=None):
+    """Run every unit to completion across all participating hosts.
+
+    Returns a stats dict. Raises RuntimeError (with the standard
+    "re-run with resume" message) if units failed on this host and no
+    other host completed them within the patience window.
+
+    - ``is_done(unit)`` — the unit's completion record, or None when not
+      done. Done-ness is record EXISTENCE (``is not None``): an empty
+      ``{}`` record from a zero-sample unit is still done.
+    - ``sweep(unit)`` — remove a prior attempt's partial outputs; called
+      on EVERY claim before running (cheap no-op on first attempts).
+    - ``task(unit, epoch, holder)`` — the unit body; picklable when an
+      ``executor_factory`` is given (spawn pool), else any callable.
+    - ``publish(unit, result, lease)`` — journal completion; called only
+      after the fence check passed. May return False to signal a
+      post-publish fence loss (the unit stays pending).
+    """
+    from concurrent.futures.process import BrokenProcessPool
+
+    lease_root = leases.lease_root(spec["out_dir"])
+    ledger_dir = os.path.join(spec["out_dir"], _runner._LEDGER_DIR)
+
+    def run_finalized():
+        """True once another host's finalize has retired the ledger. The
+        finalizer renames ``_done`` away atomically before deleting it, so
+        "completion record missing AND ledger dir missing" unambiguously
+        means "everything finished" — never "unit needs redoing". Without
+        this, a host racing the finalize would reclaim a finished unit,
+        sweep its FINAL outputs, and regenerate them from a spool that no
+        longer exists."""
+        return not os.path.isdir(ledger_dir)
+
+    poll = poll_s if poll_s is not None else max(0.05, min(ttl / 4.0, 2.0))
+    stats = {"units": len(units), "completed": 0, "stolen": 0,
+             "fence_rejects": 0, "already_done": 0}
+    # Done-ness is "a record EXISTS", never record truthiness: a gather
+    # unit whose buckets produced zero samples journals a legitimately
+    # empty {} record, and treating that as "not done" would make every
+    # host redo empty units forever (the static resume path compares
+    # `is None` for the same reason).
+    remaining = set(u for u in units if is_done(u) is None)
+    stats["already_done"] = len(units) - len(remaining)
+    progress = _runner._Progress(log, phase, len(remaining),
+                                 interval_s=progress_interval)
+    order = _rotated(units, holder)
+    failed = {}
+    inflight = {}  # future -> (unit, lease)
+    executor = None
+
+    def ensure_executor():
+        nonlocal executor
+        if executor is None:
+            executor = (executor_factory() if executor_factory is not None
+                        else _InlineExecutor())
+        return executor
+
+    def drop_inflight(fut):
+        unit, lease = inflight.pop(fut)
+        keeper.remove(lease)
+        return unit, lease
+
+    def fence_reject(unit, lease, why):
+        stats["fence_rejects"] += 1
+        obs.inc("lease_fence_rejects_total")
+        obs.event("lease.fence_reject", unit="{}{}".format(
+            unit_prefix, unit), epoch=lease.epoch)
+        log("{}: unit {} {} at epoch {}; late result discarded "
+            "(fence)".format(phase, unit, why, lease.epoch))
+
+    def handle_completed(fut):
+        unit, lease = drop_inflight(fut)
+        try:
+            result = fut.result()
+        except BrokenProcessPool:
+            # A dead pool worker breaks the whole pool and names no
+            # culprit. Release so any host (us included) can reclaim
+            # immediately; the per-claim sweep redoes partial outputs.
+            leases.release(lease)
+            raise
+        except leases.LeaseLost:
+            # The unit body self-terminated mid-run (the thief owns the
+            # unit now). Not a failure: the winner's record will appear.
+            fence_reject(unit, lease, "self-terminated (stolen)")
+            return
+        except Exception as e:  # noqa: BLE001 - isolate per unit
+            if lease.lost or not leases.verify(lease):
+                # An error on a unit we no longer own is zombie noise,
+                # not a unit failure: a thief may have swept our spool
+                # files mid-append, or a finalizer may already be
+                # deleting the run's scaffolding under us.
+                fence_reject(unit, lease,
+                             "errored after losing its lease "
+                             "({}: {})".format(type(e).__name__, e))
+                return
+            leases.release(lease)
+            failed[unit] = "{}: {}".format(type(e).__name__, e)
+            remaining.discard(unit)
+            log("{}: unit {} failed ({}); lease released for another "
+                "host".format(phase, unit, failed[unit]))
+            return
+        if lease.lost or not leases.verify(lease):
+            # Stolen while we ran (we stalled past the deadline): the
+            # thief owns the unit now; discard our late result.
+            fence_reject(unit, lease, "was stolen while this host ran it")
+            return
+        if publish(unit, result, lease) is False:
+            fence_reject(unit, lease, "lost its lease during publish")
+            return
+        leases.release(lease)
+        if lease.epoch > 0:
+            stats["stolen"] += 1
+        stats["completed"] += 1
+        # Label = the phase word ("scatter"/"gather"/"process"), not the
+        # constant "elastic" prefix of the display name.
+        obs.inc("elastic_units_completed_total", phase=phase.split()[-1])
+        remaining.discard(unit)
+        progress.tick(sum(result.values())
+                      if isinstance(result, dict) else 0)
+
+    def drain(timeout):
+        if not inflight:
+            return
+        done, _ = cf.wait(list(inflight), timeout=timeout,
+                          return_when=cf.FIRST_COMPLETED)
+        for fut in done:
+            if fut not in inflight:
+                continue  # a pool reset already dropped it
+            try:
+                handle_completed(fut)
+            except BrokenProcessPool:
+                nonlocal_executor_reset()
+
+    def nonlocal_executor_reset():
+        nonlocal executor
+        log("{}: pool worker died; releasing {} in-flight lease(s) and "
+            "rebuilding the pool".format(phase, len(inflight)))
+        for fut in list(inflight):
+            _, lease = drop_inflight(fut)
+            leases.release(lease)
+        if executor is not None:
+            executor.shutdown(wait=False)
+            executor = None
+
+    try:
+        while remaining:
+            claimed_any = False
+            inflight_units = {u for u, _ in inflight.values()}
+            for unit in order:
+                if len(inflight) >= max_inflight:
+                    break
+                if unit not in remaining or unit in inflight_units \
+                        or unit in failed:
+                    continue
+                if is_done(unit) is not None:
+                    remaining.discard(unit)
+                    progress.tick()
+                    continue
+                if run_finalized():
+                    remaining.clear()
+                    break
+                lease = leases.try_acquire(
+                    lease_root, "{}{}".format(unit_prefix, unit), holder,
+                    ttl)
+                if lease is None:
+                    continue  # validly held elsewhere (or race lost)
+                if is_done(unit) is not None:
+                    # Completion records publish BEFORE leases release, so
+                    # re-checking after the acquire closes the race where
+                    # our pre-claim is_done read predated the winner's
+                    # publish: without this, we would sweep (and redo) a
+                    # unit whose outputs are already final.
+                    leases.release(lease)
+                    remaining.discard(unit)
+                    progress.tick()
+                    continue
+                if run_finalized():
+                    # Checked AFTER the missing-record read, never before:
+                    # a finalize landing between the two checks makes a
+                    # COMPLETED unit's record read as missing, and
+                    # proceeding to sweep would delete final shards the
+                    # (already-deleted) spool can't regenerate. Dir still
+                    # present here ⇒ the None above was genuine; dir gone
+                    # ⇒ everything (including this unit) finished.
+                    # try_acquire's makedirs may also have resurrected
+                    # _leases in the finalized dir: release and prune.
+                    leases.release(lease)
+                    _prune_empty_scaffolding(spec["out_dir"])
+                    remaining.clear()
+                    break
+                sweep(unit)
+                keeper.add(lease)
+                try:
+                    fut = ensure_executor().submit(task, unit, lease.epoch,
+                                                   holder)
+                except BrokenProcessPool:
+                    # The pool broke while we were scanning (a worker died
+                    # between drains): submit itself raises. Hand back the
+                    # just-claimed lease, tear the pool down, rescan.
+                    keeper.remove(lease)
+                    leases.release(lease)
+                    nonlocal_executor_reset()
+                    continue
+                inflight[fut] = (unit, lease)
+                inflight_units.add(unit)
+                claimed_any = True
+            if inflight:
+                drain(timeout=poll)
+            elif not claimed_any and remaining:
+                # Everything left is held by other live hosts (or just
+                # journaled): wait for records to appear or leases to
+                # expire, then rescan.
+                time.sleep(poll)
+    finally:
+        if executor is not None:
+            executor.shutdown(wait=False)
+
+    if failed:
+        # Another host may still complete what we could not (our failure
+        # released the lease). Wait a patience window that resets on any
+        # progress — a completed record OR a live lease on the unit
+        # (another host actively redoing it renews at ttl/3; its unit may
+        # legitimately take many TTLs, so a fixed countdown would raise a
+        # spurious failure on a run that globally succeeds).
+        patience = max(2.0 * ttl, 3.0)
+        deadline = time.monotonic() + patience
+        while failed and time.monotonic() < deadline:
+            if run_finalized():
+                failed.clear()  # everything completed (and was retired)
+                break
+            progressing = False
+            for u in sorted(failed):
+                if is_done(u) is not None:
+                    failed.pop(u)
+                    progressing = True
+                elif leases.is_live(lease_root,
+                                    "{}{}".format(unit_prefix, u)):
+                    progressing = True
+            if progressing:
+                deadline = time.monotonic() + patience
+            if failed:
+                time.sleep(poll)
+        if failed:
+            raise RuntimeError(
+                "{} failed for {} unit(s) (this host: {}); completed units "
+                "are journaled — re-run with resume=True/--resume to redo "
+                "only the failures".format(phase, len(failed), failed))
+    return stats
+
+
+# --------------------------------------------------------------- pipeline
+
+
+def _pool_factory_for(process_bucket, spec, workers, n_units):
+    if workers <= 1 or n_units <= 1:
+        return None
+
+    def factory():
+        import multiprocessing
+        return cf.ProcessPoolExecutor(
+            max_workers=min(workers, n_units),
+            mp_context=multiprocessing.get_context("spawn"),
+            initializer=_runner._pool_init,
+            initargs=(process_bucket, spec))
+
+    return factory
+
+
+def _census_from_disk(out_dir):
+    """Recover the {path: rows} census from the output files themselves —
+    the fallback when another host finalized (and deleted ``_done``)
+    between our last unit and our merge. Parquet rows come from footers;
+    txt shards count lines."""
+    import glob
+    written = {}
+    for path in sorted(glob.glob(os.path.join(out_dir, "part.*"))):
+        if ".tmp." in path:
+            continue
+        if ".parquet" in path:
+            import pyarrow.parquet as pq
+            written[path] = pq.read_metadata(path).num_rows
+    for path in sorted(glob.glob(os.path.join(out_dir, "*.txt*"))):
+        if ".tmp." in path or os.path.basename(path).startswith("."):
+            continue
+        written[path] = rio.read_bytes(path).count(b"\n")
+    return written
+
+
+def _merge_census(out_dir, gather_units):
+    """Union of every gather unit's ledger record — the global census (in
+    elastic mode hosts do not own disjoint buckets, so every host returns
+    the merged totals). The claim loop observed every record before this
+    runs; a record missing NOW means another host's finalize is already
+    deleting the ledger, and the on-disk output files (all final at this
+    point) are the authoritative fallback."""
+    written = {}
+    for g in gather_units:
+        rec = _runner._ledger_read(out_dir, g)
+        if rec is None:
+            _log.info("ledger record for unit %s already cleaned up by "
+                      "another host's finalize; recovering the census "
+                      "from the output files", g)
+            return _census_from_disk(out_dir)
+        written.update(rec)
+    return written
+
+
+def _finalize(spec, holder, ttl, keeper, log, poll):
+    """Lease-guarded gather-side finalization: integrity manifest, spool/
+    ledger/debris cleanup. Exactly-once in the common case; crash-tolerant
+    because a dead finalizer's lease expires and a survivor redoes it
+    (every step is idempotent: the manifest is deterministic, the rmtrees
+    tolerate absence). The lease directory is deleted LAST — waiting
+    hosts treat its disappearance as "finalized"."""
+    from ..resilience.integrity import build_manifest
+
+    out_dir = spec["out_dir"]
+    root = leases.lease_root(out_dir)
+    while True:
+        if not os.path.isdir(root):
+            return False  # another host finished the whole run
+        lease = leases.try_acquire(root, _FINALIZE_UNIT, holder,
+                                   max(ttl, 5.0))
+        if lease is None:
+            time.sleep(poll)
+            continue
+        keeper.add(lease)
+        try:
+            with obs.span("preprocess.finalize", holder=holder):
+                build_manifest(out_dir, comm=LocalCommunicator(), log=log)
+                if not leases.verify(lease):
+                    obs.inc("lease_fence_rejects_total")
+                    log("finalize: lease stolen mid-manifest; yielding to "
+                        "the new finalizer")
+                    time.sleep(poll)
+                    continue
+                if spec["global_shuffle"]:
+                    shutil.rmtree(os.path.join(out_dir, _runner._SPOOL_DIR),
+                                  ignore_errors=True)
+                # Retire the ledger ATOMICALLY (rename, then delete the
+                # renamed dir): hosts still scanning must see either the
+                # complete record set or no ledger dir at all — a
+                # half-deleted ledger reads as "unit not done" and would
+                # trigger a catastrophic reclaim of finished outputs.
+                # Stale retired dirs (a finalizer that died between ITS
+                # rename and rmtree) are swept FIRST: renaming onto an
+                # existing non-empty dir would fail ENOTEMPTY, and a
+                # same-holder resume must not mistake that for "already
+                # retired" and leave _done/ behind forever.
+                import glob
+                ledger = os.path.join(out_dir, _runner._LEDGER_DIR)
+                for stale in sorted(glob.glob(ledger + ".retired.*")):
+                    shutil.rmtree(stale, ignore_errors=True)
+                retired = "{}.retired.{}".format(ledger, holder)
+                try:
+                    os.replace(ledger, retired)  # lddl: disable=atomic-publish
+                except FileNotFoundError:
+                    retired = None  # already retired by someone else
+                if retired is not None:
+                    shutil.rmtree(retired, ignore_errors=True)
+                _runner._sweep_tmp_debris(out_dir)
+                shutil.rmtree(root, ignore_errors=True)
+                return True
+        finally:
+            keeper.remove(lease)
+
+
+def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
+                         workers, progress_interval, t0, poll_s=None):
+    """The elastic replacement for the static scatter/gather schedule.
+    Called from ``runner._run_pipeline_body`` after the dirty-dir guard
+    and fingerprint manifest check; every participating host runs this
+    with identical arguments (modulo ``holder_id``)."""
+    out_dir = spec["out_dir"]
+    holder = (leases.sanitize_holder(holder_id) if holder_id
+              else leases.default_holder())
+    ttl = float(lease_ttl)
+    if ttl <= 0:
+        raise ValueError("lease_ttl must be > 0, got {}".format(lease_ttl))
+    poll = poll_s if poll_s is not None else max(0.05, min(ttl / 4.0, 2.0))
+    keeper = leases.LeaseKeeper(ttl)
+    log("elastic preprocess: holder={} ttl={}s".format(holder, ttl))
+    totals = {"completed": 0, "stolen": 0, "fence_rejects": 0}
+
+    def add_stats(stats):
+        for k in totals:
+            totals[k] += stats[k]
+
+    try:
+        if spec["global_shuffle"]:
+            n_slices = spec["scatter_units"]
+            scatter_units = list(range(n_slices))
+            factory = _pool_factory_for(process_bucket, spec, workers,
+                                        n_slices)
+            # The accept set: exactly the winning attempt's spool files
+            # per slice, read back STABLY after every slice is journaled
+            # — identical on every host regardless of who ran what. A
+            # "retry" (a record withdrawn by a fenced loser who then
+            # died) re-enters the claim loop, which skips done units and
+            # redoes only the un-journaled one.
+            while True:
+                with obs.span("preprocess.scatter", elastic=True,
+                              holder=holder):
+                    add_stats(claim_loop(
+                        spec, "elastic scatter", _SCATTER_PREFIX,
+                        scatter_units,
+                        holder=holder, ttl=ttl, keeper=keeper,
+                        is_done=lambda u: _read_scatter_record(out_dir, u),
+                        sweep=lambda u: _sweep_scatter(spec, u),
+                        task=(_pool_scatter_slice if factory else
+                              (lambda u, e, h: _scatter_slice(
+                                  spec, u, e, h))),
+                        publish=lambda u, res, lease:
+                            _publish_scatter_record(out_dir, u, lease),
+                        executor_factory=factory,
+                        max_inflight=max(1, workers),
+                        log=log, progress_interval=progress_interval,
+                        poll_s=poll_s))
+                status, recs = _stable_scatter_records(
+                    out_dir, scatter_units, leases.lease_root(out_dir),
+                    ttl, poll)
+                if status != "retry":
+                    break
+                log("elastic scatter: a completion record was withdrawn "
+                    "with no live holder; re-entering the claim loop")
+            if status == "ok":
+                spec["spool_accept"] = sorted(
+                    spool_name(u, recs[u]["epoch"], recs[u]["holder"])
+                    for u in scatter_units)
+            else:
+                log("elastic: run already finalized by another host during "
+                    "this host's scatter phase")
+            gather_units = list(range(spec["ngroups"]))
+            gather_prefix, gather_phase = _GROUP_PREFIX, "elastic gather"
+            gather_task_pool, gather_sweep = _pool_gather_group, _sweep_gather
+
+            def serial_gather(u, e, h):
+                return _runner._run_group(
+                    spec, process_bucket, u,
+                    fence=_fence_for(out_dir, _GROUP_PREFIX, u, e, h))
+        else:
+            gather_units = list(range(spec["nbuckets"]))
+            gather_prefix, gather_phase = _BLOCK_PREFIX, "elastic process"
+            gather_task_pool, gather_sweep = _pool_block_bucket, _sweep_block
+
+            def serial_gather(u, e, h):
+                return _runner._run_block_bucket(
+                    spec, process_bucket, u,
+                    fence=_fence_for(out_dir, _BLOCK_PREFIX, u, e, h))
+
+        factory = _pool_factory_for(process_bucket, spec, workers,
+                                    len(gather_units))
+        with obs.span("preprocess.gather", elastic=True, holder=holder):
+            add_stats(claim_loop(
+                spec, gather_phase, gather_prefix, gather_units,
+                holder=holder, ttl=ttl, keeper=keeper,
+                is_done=lambda u: _runner._ledger_read(out_dir, u),
+                sweep=lambda u: gather_sweep(spec, u),
+                task=gather_task_pool if factory else serial_gather,
+                publish=lambda u, res, lease: _publish_gather_record(
+                    out_dir, u, res, lease),
+                executor_factory=factory, max_inflight=max(1, workers),
+                log=log, progress_interval=progress_interval,
+                poll_s=poll_s))
+
+        # Merge the global census BEFORE finalize can delete the ledger.
+        written = _merge_census(out_dir, gather_units)
+        log("elastic summary: holder={} units={} steals={} "
+            "fence_rejects={}".format(holder, totals["completed"],
+                                      totals["stolen"],
+                                      totals["fence_rejects"]))
+        _finalize(spec, holder, ttl, keeper, log, poll)
+    finally:
+        keeper.stop()
+
+    elapsed = time.time() - t0  # lddl: disable=wall-clock (log-only rates)
+    if obs.enabled():
+        obs.set_gauge("preprocess_samples_per_second",
+                      sum(written.values()) / max(elapsed, 1e-9))
+        docs = obs.registry().counter("preprocess_docs_total").total()
+        if docs:
+            obs.set_gauge("preprocess_docs_per_second",
+                          docs / max(elapsed, 1e-9))
+    log("preprocess done in {:.1f}s, {} shards, {} samples (elastic, "
+        "global census)".format(elapsed, len(written),
+                                sum(written.values())))
+    return written

@@ -12,9 +12,14 @@ Three small layers the whole pipeline rides:
                   fail/quarantine policy. (Imported lazily by consumers —
                   it depends on utils/ and parallel/, unlike faults/io
                   which are stdlib-only.)
+- ``leases``    — atomic-rename lease files with epoch fencing: the
+                  coordination layer the elastic work-stealing preprocess
+                  runner claims its units through (any host may die
+                  mid-unit and be reclaimed by the survivors).
 """
 
 from . import faults
+from . import leases
 from .io import (
     TRANSIENT_ERRNOS,
     atomic_publish,
@@ -30,6 +35,7 @@ from .io import (
 
 __all__ = [
     "faults",
+    "leases",
     "TRANSIENT_ERRNOS",
     "atomic_publish",
     "atomic_write",

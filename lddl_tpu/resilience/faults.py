@@ -15,13 +15,16 @@ Spec grammar — comma-separated clauses of colon-separated fields::
     <op>:<kind>[:p=<float>][:nth=<int>][:max=<int>][:seed=<int>]
                [:path=<substr>][:delay=<float>][:flag=<file>]
 
-    op    site name: open | read | replace | worker (or * for any site)
-    kind  eio | estale | truncate | slow | kill
+    op    site name: open | read | replace | worker | lease-acquire |
+          lease-renew | lease-release (or * for any site)
+    kind  eio | estale | truncate | slow | stall | kill
     p     per-call injection probability (seeded per process)
     nth   inject on exactly the Nth matching call of this process
     max   cap on injections per process (default: 1 for nth, unlimited for p)
     path  only calls whose path/tag contains this substring match
-    delay sleep seconds for kind=slow (default 0.2)
+    delay sleep seconds for kind=slow (default 0.2) and kind=stall
+          (default 30; set it past the lease TTL at a lease-renew site to
+          freeze the renewal and force a steal)
     flag  cross-process once-latch: inject only while <file> does not
           exist, and create it upon injection (survives respawned workers)
 
@@ -30,6 +33,8 @@ Examples::
     LDDL_TPU_FAULTS="read:eio:p=0.2:seed=7"        # flaky shard reads
     LDDL_TPU_FAULTS="open:kill:nth=5:path=_shuffle:flag=/tmp/k1"
     LDDL_TPU_FAULTS="worker:kill:nth=2:flag=/tmp/k2"  # loader worker death
+    LDDL_TPU_FAULTS="lease-renew:stall:nth=1:delay=20"  # freeze renewal,
+                                                        # force a steal
 """
 
 import errno
@@ -59,11 +64,12 @@ def _parse_clause(text, index):
         raise FaultSpecError(
             "fault clause {!r} needs at least <op>:<kind>".format(text))
     op, kind = fields[0].strip(), fields[1].strip()
-    if kind not in ("eio", "estale", "truncate", "slow", "kill"):
+    if kind not in ("eio", "estale", "truncate", "slow", "stall", "kill"):
         raise FaultSpecError("unknown fault kind {!r} in {!r}".format(
             kind, text))
     clause = {"op": op, "kind": kind, "p": None, "nth": None, "max": None,
-              "seed": 0, "path": None, "delay": 0.2, "flag": None,
+              "seed": 0, "path": None,
+              "delay": 30.0 if kind == "stall" else 0.2, "flag": None,
               "index": index}
     for field in fields[2:]:
         if "=" not in field:
@@ -175,7 +181,10 @@ def fault_point(op, path=None):
         if not _should_inject(clause, op, path):
             continue
         kind = clause["kind"]
-        if kind == "slow":
+        if kind in ("slow", "stall"):
+            # "stall" is "slow" with a freeze-scale default: parked at a
+            # lease-renew site it outlives the lease TTL, so the deadline
+            # passes mid-renewal and another host steals the unit.
             _latch(clause, op)
             time.sleep(clause["delay"])
         elif kind == "kill":

@@ -25,6 +25,7 @@ Env knobs (all optional)::
 """
 
 import errno
+import json
 import os
 import random
 import time
@@ -202,6 +203,25 @@ def read_bytes(path, retries=True):
     if retries:
         return with_retries(_read, desc="read {}".format(path))
     return _read()
+
+
+def read_json(path, retries=True):
+    """Read a small JSON record with transient-error retries: returns
+    ``(value, "ok")``, ``(None, "missing")`` on ENOENT, or
+    ``(raw_bytes, "torn")`` when the bytes don't parse (flaky storage
+    serving a torn read). The one reader behind every ledger / scatter
+    record / lease file, so torn-record semantics cannot drift between
+    them: callers decide what "torn" means for their record type (always
+    some flavor of "not done"/"expired" — records are written atomically,
+    so torn bytes implicate the medium, not the writer)."""
+    try:
+        data = read_bytes(path, retries=retries)
+    except FileNotFoundError:
+        return None, "missing"
+    try:
+        return json.loads(data), "ok"
+    except ValueError:
+        return data, "torn"
 
 
 def open_append(path, retries=True):

@@ -1,0 +1,376 @@
+"""Atomic-rename lease protocol for multi-host work stealing.
+
+N independent host processes share nothing but the output directory
+(GCS-fuse/NFS — the same medium the shards ride). Each work unit gets one
+lease file ``<out>/_leases/<unit>.json`` carrying ``(holder, epoch,
+deadline)``:
+
+- **acquire**: a missing lease is claimed by writing a holder-unique temp
+  file and hard-linking it into place (``os.link`` fails with EEXIST if
+  someone else got there first — the classic NFS-safe exclusive create;
+  filesystems without link support fall back to ``O_CREAT|O_EXCL``).
+- **renew**: the holder republishes the lease with a pushed-out deadline
+  via tmp + ``os.replace`` (:func:`resilience.io.atomic_publish`), then
+  reads it back; a mismatch means the lease was stolen (`LeaseLost`).
+- **steal**: anyone may replace an EXPIRED lease, bumping the **epoch**.
+  Replace + read-back does not serialize concurrent stealers perfectly —
+  two may transiently both believe they won — and that is fine *by
+  design*: mutual exclusion here is an efficiency lever, never the
+  correctness mechanism.
+- **fence**: correctness comes from epoch fencing at publish time. Before
+  journaling a completed unit, the holder re-reads the lease and publishes
+  ONLY if ``(holder, epoch)`` still match; a stalled-then-resurrected
+  holder sees the bumped epoch, discards its late result, and
+  self-terminates the unit (``lease_fence_rejects_total``). Unit outputs
+  that cannot be replaced idempotently (scatter spool appends) additionally
+  carry ``(epoch, holder)`` in their file names, so a loser's debris is
+  never read and never collides with the winner's files.
+
+Lease files are scheduling state, never data: nothing in them (holder id,
+epoch, wall-clock deadline) may flow into shard bytes or
+``.manifest.json`` content — machine-checked by the analyzer's
+``lease-isolation`` flow rule. Deadlines are wall-clock on purpose (the
+one cross-host time base a shared filesystem gives us); this module is
+the single place the pipeline reads the wall clock for control flow, and
+it is allowlisted for exactly that.
+
+Chaos sites: ``lease-acquire`` / ``lease-renew`` / ``lease-release`` fault
+points fire at the guarded operations; the ``stall`` fault kind freezes a
+renewal past the deadline to force a steal (see ``faults.py``).
+"""
+
+import json
+import logging
+import os
+import re
+import socket
+import threading
+import time
+import uuid
+
+from . import faults
+from . import io as rio
+from ..observability import event as obs_event
+from ..observability import inc as obs_inc
+
+LEASE_DIR = "_leases"
+
+_log = logging.getLogger("lddl_tpu.resilience.leases")
+
+_SAFE_RE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+
+class LeaseLost(RuntimeError):
+    """The lease was stolen (epoch bumped / holder replaced) out from
+    under its holder; the unit in flight must be self-terminated."""
+
+
+class Lease(object):
+    """One held lease. ``lost`` is flipped by the keeper thread when a
+    renewal discovers the lease was stolen; the claim loop checks it (and
+    re-verifies on disk) before publishing the unit."""
+
+    __slots__ = ("root", "unit", "holder", "epoch", "deadline", "lost")
+
+    def __init__(self, root, unit, holder, epoch, deadline):
+        self.root = root
+        self.unit = unit
+        self.holder = holder
+        self.epoch = epoch
+        self.deadline = deadline
+        self.lost = False
+
+    @property
+    def path(self):
+        return lease_path(self.root, self.unit)
+
+    def __repr__(self):
+        return "Lease({}@{} epoch={})".format(self.unit, self.holder,
+                                              self.epoch)
+
+
+def default_holder():
+    """Unique-per-process holder id: hostname + pid + a random tag (a
+    respawned process recycling a pid must not mistake its dead
+    predecessor's lease for its own). Lease-file state only — never data."""
+    return sanitize_holder("{}-{}-{}".format(
+        socket.gethostname(), os.getpid(), uuid.uuid4().hex[:6]))
+
+
+def sanitize_holder(holder):
+    """Holder ids land in file names (lease temps, scatter spool files);
+    restrict them to a safe charset."""
+    safe = _SAFE_RE.sub("-", str(holder)).strip("-")
+    if not safe:
+        raise ValueError("holder id {!r} is empty after sanitizing".format(
+            holder))
+    return safe
+
+
+def lease_root(out_dir):
+    return os.path.join(out_dir, LEASE_DIR)
+
+
+def lease_path(root, unit):
+    return os.path.join(root, "{}.json".format(unit))
+
+
+def read_lease(root, unit):
+    """The current lease record for ``unit``, or None when absent.
+
+    Reads ride :func:`resilience.io.read_bytes` (transient-error retries +
+    fault injection). A torn/empty record — possible only through storage
+    misbehaviour, every writer publishes complete temp files — reads as an
+    expired epoch-0 lease with a warning, so a flaky byte never wedges the
+    scheduler; the fence still protects the ledger."""
+    path = lease_path(root, unit)
+    rec, status = rio.read_json(path)
+    if status == "missing":
+        return None
+    if status == "ok" and isinstance(rec, dict):
+        return rec
+    _log.warning("torn/unparseable lease file %s; treating as expired",
+                 path)
+    obs_inc("lease_torn_reads_total")
+    return {"unit": unit, "holder": "", "epoch": 0, "deadline": 0.0,
+            "torn": True}
+
+
+def _record(unit, holder, epoch, deadline):
+    return {"unit": unit, "holder": holder, "epoch": int(epoch),
+            "deadline": float(deadline)}
+
+
+def _write_tmp(path, rec, holder):
+    """Fully write a holder-unique temp next to ``path`` (unique name: two
+    hosts — or two threads — publishing the same lease can never interleave
+    bytes in a shared temp the way a pid-keyed name could)."""
+    tmp = "{}.tmp.{}".format(path, holder)
+    # Pre-publish scratch with a holder-unique name, promoted only via
+    # os.link / atomic_publish below; a torn temp is never trusted.
+    with open(tmp, "wb") as f:  # lddl: disable=atomic-publish
+        f.write(json.dumps(rec, sort_keys=True).encode("utf-8"))
+        f.flush()
+        os.fsync(f.fileno())
+    return tmp
+
+
+def _cleanup_tmp(tmp):
+    try:
+        os.unlink(tmp)
+    except FileNotFoundError:
+        pass
+
+
+def _matches(rec, holder, epoch):
+    return (rec is not None and rec.get("holder") == holder
+            and rec.get("epoch") == epoch)
+
+
+def _try_create(path, rec, holder):
+    """Exclusive create of a fresh lease file. ``os.link`` is atomic and
+    fails loudly on EEXIST even on NFS; filesystems that refuse hard links
+    fall back to O_CREAT|O_EXCL (fine everywhere the fallback runs: a FUSE
+    mount without link support is also not an NFSv2 mount)."""
+    tmp = _write_tmp(path, rec, holder)
+    try:
+        try:
+            os.link(tmp, path)
+            return True
+        except FileExistsError:
+            return False
+        # Deliberate fallthrough, not a swallow: EPERM/ENOTSUP here means
+        # the mount refuses hard links; the O_EXCL path below performs the
+        # same exclusive create. -- lddl: disable=swallowed-error
+        except OSError:
+            pass
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        try:
+            with open(tmp, "rb") as f:
+                os.write(fd, f.read())
+        finally:
+            os.close(fd)
+        return True
+    finally:
+        _cleanup_tmp(tmp)
+
+
+def _publish(path, rec, holder):
+    """Replace the lease file with a fully-written record (tmp + fsync +
+    ``os.replace`` + dir fsync via resilience.io)."""
+    tmp = _write_tmp(path, rec, holder)
+    try:
+        rio.atomic_publish(tmp, path)
+    finally:
+        _cleanup_tmp(tmp)
+
+
+def try_acquire(root, unit, holder, ttl_s, now_fn=time.time):
+    """Claim ``unit``: returns a :class:`Lease` on success, None when the
+    unit is validly held by someone else (or a race was lost).
+
+    A missing lease is created exclusively at epoch 0. An expired (or
+    torn) lease is **stolen**: the epoch is bumped and the record
+    replaced, then read back — only the claimant whose bytes survived the
+    replace race proceeds. The read-back does not make concurrent steals
+    perfectly exclusive; the publish-time fence does (module docstring)."""
+    os.makedirs(root, exist_ok=True)
+    path = lease_path(root, unit)
+    faults.fault_point("lease-acquire", path)
+    cur = read_lease(root, unit)
+    now = now_fn()
+    if cur is None:
+        rec = _record(unit, holder, 0, now + ttl_s)
+        if _try_create(path, rec, holder):
+            got = read_lease(root, unit)
+            if _matches(got, holder, 0):
+                obs_inc("lease_acquires_total")
+                return Lease(root, unit, holder, 0, rec["deadline"])
+        obs_inc("lease_acquire_conflicts_total")
+        return None
+    if float(cur.get("deadline", 0.0)) > now and not cur.get("torn"):
+        # Validly held (possibly by a past incarnation of ourselves — a
+        # claim loop never double-claims, so "held by my id" is equally
+        # a conflict here).
+        obs_inc("lease_acquire_conflicts_total")
+        return None
+    new_epoch = int(cur.get("epoch", 0)) + 1
+    rec = _record(unit, holder, new_epoch, now + ttl_s)
+    _publish(path, rec, holder)
+    got = read_lease(root, unit)
+    if _matches(got, holder, new_epoch):
+        obs_inc("lease_acquires_total")
+        obs_inc("lease_steals_total")
+        obs_event("lease.steal", unit=str(unit), epoch=new_epoch,
+                  prev_holder=str(cur.get("holder", "")))
+        return Lease(root, unit, holder, new_epoch, rec["deadline"])
+    obs_inc("lease_acquire_conflicts_total")
+    return None
+
+
+def renew(lease, ttl_s, now_fn=time.time):
+    """Push the deadline out by ``ttl_s``. Raises :class:`LeaseLost` when
+    the on-disk record no longer names this holder+epoch (stolen while we
+    stalled). The ``lease-renew`` fault site fires BEFORE the read, so an
+    injected ``stall`` freezes the renewal long enough for the deadline to
+    pass and a steal to land — exactly the scenario the fence exists for."""
+    path = lease.path
+    faults.fault_point("lease-renew", path)
+    cur = read_lease(lease.root, lease.unit)
+    if not _matches(cur, lease.holder, lease.epoch):
+        lease.lost = True
+        raise LeaseLost("lease for unit {} was stolen (now {})".format(
+            lease.unit, cur))
+    rec = _record(lease.unit, lease.holder, lease.epoch, now_fn() + ttl_s)
+    _publish(path, rec, lease.holder)
+    got = read_lease(lease.root, lease.unit)
+    if not _matches(got, lease.holder, lease.epoch):
+        lease.lost = True
+        raise LeaseLost("lease for unit {} lost during renewal".format(
+            lease.unit))
+    lease.deadline = rec["deadline"]
+    obs_inc("lease_renews_total")
+    return lease
+
+
+def verify(lease):
+    """Fence check: True iff the on-disk lease still names this holder AND
+    epoch. Run immediately before journaling a completed unit; False means
+    the unit was reclaimed and this result must be discarded."""
+    if lease.lost:
+        return False
+    return verify_at(lease.root, lease.unit, lease.holder, lease.epoch)
+
+
+def is_live(root, unit, now_fn=time.time):
+    """True while SOME host validly holds ``unit`` (unexpired, untorn
+    lease) — i.e. the unit is actively being worked on. Used by the
+    claim loop's failure-patience logic: a host must not declare the run
+    failed while another live host is still redoing the unit (the
+    wall-clock comparison lives here so steal.py stays clock-free)."""
+    rec = read_lease(root, unit)
+    return (rec is not None and not rec.get("torn")
+            and float(rec.get("deadline", 0.0)) > now_fn())
+
+
+def verify_at(root, unit, holder, epoch):
+    """Stateless fence check for code that cannot carry a Lease object
+    across a process boundary (pool workers): True iff the on-disk lease
+    for ``unit`` names exactly (holder, epoch). Workers call this between
+    sub-steps to self-terminate a stolen unit early instead of wasting
+    work (and, crucially, instead of writing outputs derived from state a
+    finalizer may already be deleting)."""
+    return _matches(read_lease(root, unit), holder, epoch)
+
+
+def release(lease):
+    """Drop a completed unit's lease (verified unlink). Best-effort: the
+    unit's ledger record is the durable completion signal — claim loops
+    check the ledger before the lease — so a leftover lease file is inert
+    and gets swept with the rest of ``_leases/`` at finalize."""
+    faults.fault_point("lease-release", lease.path)
+    if verify(lease):
+        try:
+            os.unlink(lease.path)
+        except FileNotFoundError:
+            pass
+        obs_inc("lease_releases_total")
+
+
+class LeaseKeeper(object):
+    """One background thread renewing every lease this host holds, at
+    ``ttl/3``. A renewal that discovers a steal marks ``lease.lost`` (and
+    stops renewing it); the claim loop's fence does the rest. Transient
+    storage errors are retried inside the lease I/O; anything else is
+    conservatively treated as lost — without renewals the lease expires
+    anyway, and redoing a unit is always safe."""
+
+    def __init__(self, ttl_s):
+        self.ttl_s = ttl_s
+        self._leases = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+
+    def add(self, lease):
+        with self._lock:
+            self._leases.add(lease)
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="lease-keeper", daemon=True)
+                self._thread.start()
+
+    def remove(self, lease):
+        with self._lock:
+            self._leases.discard(lease)
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _run(self):
+        period = max(self.ttl_s / 3.0, 0.05)
+        while not self._stop.wait(period):
+            with self._lock:
+                held = list(self._leases)
+            for lease in held:
+                if lease.lost:
+                    continue
+                try:
+                    renew(lease, self.ttl_s)
+                except LeaseLost:
+                    obs_event("lease.lost", unit=str(lease.unit),
+                              epoch=lease.epoch)
+                    _log.warning("lease for unit %s stolen at epoch %s; "
+                                 "in-flight result will be fenced off",
+                                 lease.unit, lease.epoch)
+                except Exception as e:  # noqa: BLE001 - see class docstring
+                    lease.lost = True
+                    _log.warning("lease renewal for unit %s failed (%s: "
+                                 "%s); treating as lost", lease.unit,
+                                 type(e).__name__, e)

@@ -52,7 +52,7 @@ def test_every_rule_is_registered_once():
         "manifest-determinism", "python-hot-loop",
         # project-scope (interprocedural flow) rules — tests/test_dataflow.py
         "wall-clock-flow", "rng-flow", "fs-order-flow",
-        "publish-path-flow",
+        "publish-path-flow", "lease-isolation",
     }
 
 

@@ -132,3 +132,178 @@ def test_uninterrupted_runs_are_deterministic(fixture_dirs,
     proc = _run_driver(corpus, vocab, out, resume=False)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert gs.hash_outputs(out) == reference_hashes
+
+
+# --------------------------------------------------- elastic work stealing
+
+# Driver for the elastic claim loop (same plan as _DRIVER, so the SAME
+# reference hashes apply — leases must never change output bytes).
+# argv: corpus vocab out holder ttl
+_ELASTIC_DRIVER = """
+import sys
+from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+from lddl_tpu.preprocess.runner import run_bert_preprocess
+from lddl_tpu import observability as obs
+
+corpus, vocab, out, holder, ttl = sys.argv[1:6]
+tok = get_tokenizer(vocab_file=vocab)
+cfg = BertPretrainConfig(max_seq_length=32, masking=True)
+run_bert_preprocess(
+    {"wikipedia": corpus}, out, tok, config=cfg, num_blocks=12,
+    sample_ratio=0.9, seed=4242, bin_size=8, global_shuffle=True,
+    elastic=True, lease_ttl=float(ttl), holder_id=holder, log=print)
+obs.write_summary()
+"""
+
+
+def _spawn_elastic(corpus, vocab, out, holder, ttl, fault_spec=None,
+                   metrics_dir=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if fault_spec:
+        env["LDDL_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("LDDL_TPU_FAULTS", None)
+    if metrics_dir:
+        env["LDDL_TPU_METRICS_DIR"] = metrics_dir
+    else:
+        env.pop("LDDL_TPU_METRICS_DIR", None)
+    return subprocess.Popen(
+        [sys.executable, "-c", _ELASTIC_DRIVER, corpus, vocab, out, holder,
+         str(ttl)],
+        env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def _counter_total(metrics_dir, name):
+    """Sum a counter over every process that exported into metrics_dir:
+    summaries for cleanly-exited hosts, the LAST metrics-*.jsonl snapshot
+    for SIGKILLed ones (the kill fault flushes telemetry first; such a
+    process never writes a summary)."""
+    import glob
+    import json
+    total = 0
+    seen_pids = set()
+    for path in sorted(glob.glob(os.path.join(metrics_dir,
+                                              "summary-*.json"))):
+        seen_pids.add(path.rsplit("pid", 1)[1].split(".")[0])
+        with open(path) as f:
+            snap = json.load(f)["metrics"].get(name)
+        if snap:
+            total += sum(snap["values"].values())
+    for path in sorted(glob.glob(os.path.join(metrics_dir,
+                                              "metrics-*.jsonl"))):
+        if path.rsplit("pid", 1)[1].split(".")[0] in seen_pids:
+            continue  # clean exit: already counted via its summary
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        if not lines:
+            continue
+        snap = json.loads(lines[-1])["metrics"].get(name)
+        if snap:
+            total += sum(snap["values"].values())
+    return total
+
+
+def test_elastic_sigkill_one_host_survivors_byte_identical(
+        fixture_dirs, reference_hashes, tmp_path):
+    """Three elastic host processes; one is SIGKILLed mid-gather (while
+    holding a unit's lease, before journaling it). The survivors steal
+    and redo its unit, run the lease-guarded finalize, and the merged
+    output — shards AND manifest — is byte-identical to the single-host
+    reference run."""
+    td, corpus, vocab = fixture_dirs
+    ref_out = str(tmp_path / "ref")
+    proc = _run_driver(corpus, vocab, ref_out, resume=False)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    out = str(tmp_path / "out")
+    mdirs = {h: str(tmp_path / ("m_" + h)) for h in ("h0", "h1", "h2")}
+    # h0 dies at the os.replace publishing its FIRST gather ledger
+    # record: it dies holding that unit's lease with the unit's work
+    # fully done but unjournaled — the exact "host dies holding a unit"
+    # case. It gets a head start so it is GUARANTEED to reach a gather
+    # publish before the survivors can drain the queue: the survivors
+    # launch only once h0's first scatter record is ON DISK (a blind
+    # sleep would flake on a loaded machine), and they join the
+    # in-progress run through the fingerprint manifest.
+    import time
+    procs = {
+        "h0": _spawn_elastic(corpus, vocab, out, "h0", 2.0,
+                             fault_spec="replace:kill:nth=1:path=_done/group-",
+                             metrics_dir=mdirs["h0"]),
+    }
+    records = os.path.join(out, "_done")
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and procs["h0"].poll() is None:
+        if os.path.isdir(records) and any(
+                n.startswith("scatter-") for n in os.listdir(records)):
+            break
+        time.sleep(0.1)
+    procs["h1"] = _spawn_elastic(corpus, vocab, out, "h1", 2.0,
+                                 metrics_dir=mdirs["h1"])
+    procs["h2"] = _spawn_elastic(corpus, vocab, out, "h2", 2.0,
+                                 metrics_dir=mdirs["h2"])
+    outs = {h: p.communicate(timeout=600)[0] for h, p in procs.items()}
+    assert procs["h0"].returncode == -9, outs["h0"]  # really SIGKILLed
+    assert procs["h1"].returncode == 0, outs["h1"]
+    assert procs["h2"].returncode == 0, outs["h2"]
+
+    assert gs.hash_outputs(out) == reference_hashes
+    with open(os.path.join(ref_out, ".manifest.json"), "rb") as f:
+        ref_manifest = f.read()
+    with open(os.path.join(out, ".manifest.json"), "rb") as f:
+        assert f.read() == ref_manifest
+    # All scheduling state cleaned up by the finalizer.
+    assert not os.path.isdir(os.path.join(out, "_leases"))
+    assert not os.path.isdir(os.path.join(out, "_done"))
+    assert not os.path.isdir(os.path.join(out, "_shuffle"))
+    # The dead host's unit really was stolen by a survivor.
+    steals = (_counter_total(mdirs["h1"], "lease_steals_total")
+              + _counter_total(mdirs["h2"], "lease_steals_total"))
+    assert steals >= 1
+    # Every unit journaled exactly once across the cluster: survivors +
+    # the victim's pre-kill completions account for 12 scatter slices +
+    # 12 gather groups with no double counting. (The victim's counters
+    # survive because the kill fault flushes telemetry first.)
+    done = sum(_counter_total(m, "elastic_units_completed_total")
+               for m in mdirs.values())
+    assert done == 24, done
+
+
+def test_elastic_forced_stall_fence_reject(fixture_dirs, reference_hashes,
+                                           tmp_path):
+    """Force the stall-steal-fence sequence end to end: host h0's first
+    lease renewal stalls past the TTL while its unit is artificially
+    slowed, h1 steals and redoes the unit, and h0's late publish is
+    FENCED — counted in lease_fence_rejects_total, never reaching the
+    ledger — while the final bytes stay identical to the reference."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    mdirs = {h: str(tmp_path / ("m_" + h)) for h in ("h0", "h1")}
+    procs = {
+        # Stall the first renewal for 30s (far past the 1.5s TTL) AND
+        # slow one of the stalled unit's spool appends by 5s, so the unit
+        # genuinely outlives its lease.
+        "h0": _spawn_elastic(
+            corpus, vocab, out, "h0", 1.5,
+            fault_spec=("lease-renew:stall:nth=1:delay=30,"
+                        "open:slow:nth=2:path=_shuffle:delay=5"),
+            metrics_dir=mdirs["h0"]),
+        "h1": _spawn_elastic(corpus, vocab, out, "h1", 1.5,
+                             metrics_dir=mdirs["h1"]),
+    }
+    outs = {h: p.communicate(timeout=600)[0] for h, p in procs.items()}
+    assert procs["h0"].returncode == 0, outs["h0"]
+    assert procs["h1"].returncode == 0, outs["h1"]
+
+    assert gs.hash_outputs(out) == reference_hashes
+    # The fence fired on the stalled host and the thief stole the unit.
+    assert _counter_total(mdirs["h0"], "lease_fence_rejects_total") >= 1
+    assert _counter_total(mdirs["h1"], "lease_steals_total") >= 1
+    # The fenced publish never reached the ledger: the 24 units were
+    # journaled exactly once across both hosts.
+    done = sum(_counter_total(m, "elastic_units_completed_total")
+               for m in mdirs.values())
+    assert done == 24, done

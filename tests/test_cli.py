@@ -43,3 +43,31 @@ def test_pyproject_scripts_resolve():
     assert len(entries) == 8
     for module, attr in entries:
         assert callable(getattr(importlib.import_module(module), attr))
+
+
+def test_elastic_flags_parse_and_forward():
+    """--elastic/--lease-ttl/--elastic-host-id/--scatter-units parse on
+    both preprocess CLIs and map onto the runner kwargs."""
+    from lddl_tpu.cli import common
+    from lddl_tpu.cli.preprocess_bert_pretrain import attach_args
+    args = attach_args().parse_args(
+        ["--wikipedia", "c", "--sink", "s", "--vocab-file", "v",
+         "--elastic", "--lease-ttl", "45", "--elastic-host-id", "h1",
+         "--scatter-units", "8"])
+    assert common.elastic_kwargs_of(args) == {
+        "elastic": True, "lease_ttl": 45.0, "holder_id": "h1",
+        "scatter_units": 8}
+    # Defaults: elastic off, nothing else forced.
+    args = attach_args().parse_args(
+        ["--wikipedia", "c", "--sink", "s", "--vocab-file", "v"])
+    kw = common.elastic_kwargs_of(args)
+    assert kw["elastic"] is False and kw["holder_id"] is None
+
+
+def test_elastic_and_multihost_mutually_exclusive():
+    from lddl_tpu.cli import common
+    from lddl_tpu.cli.preprocess_bart_pretrain import attach_args
+    args = attach_args().parse_args(
+        ["--wikipedia", "c", "--sink", "s", "--elastic", "--multihost"])
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        common.elastic_kwargs_of(args)

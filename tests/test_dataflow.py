@@ -427,6 +427,145 @@ def test_publish_path_flow_observability_writes_exempt(tmp_path):
     assert flow_findings(report) == []
 
 
+# ------------------------------------------ lease-isolation fixtures
+
+
+def _lease_findings(report):
+    return [f for f in report.new if f.rule == "lease-isolation"]
+
+
+# A minimal stand-in for the real lease module at its real path (the
+# engine keys lease sources off dataflow.LEASE_MODULE).
+_LEASE_FIXTURE = """
+    import json, os, time
+
+    def try_acquire(root, unit, holder, ttl_s):
+        rec = {"unit": unit, "holder": holder, "epoch": 0,
+               "deadline": time.time() + ttl_s}
+        with open(os.path.join(root, unit), "w") as f:
+            json.dump(rec, f)
+        return rec
+
+    def read_lease(root, unit):
+        with open(os.path.join(root, unit)) as f:
+            return json.load(f)
+"""
+
+
+def test_lease_isolation_publish_argument_true_positive(tmp_path):
+    """Lease state (the epoch) flowing into an atomic_write payload in a
+    pipeline module: the exact corruption the fence exists to prevent —
+    lease scheduling state reaching published bytes."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/resilience/leases.py": _LEASE_FIXTURE,
+        "lddl_tpu/resilience/io.py": """
+            def atomic_write(path, data):
+                return None
+        """,
+        "lddl_tpu/preprocess/bad.py": """
+            from ..resilience import leases
+            from ..resilience.io import atomic_write
+
+            def journal(root, unit, out):
+                lease = leases.try_acquire(root, unit, "h", 5.0)
+                atomic_write(out, str(lease["epoch"]))
+        """,
+    })
+    found = _lease_findings(report)
+    assert any(f.path == "lddl_tpu/preprocess/bad.py" for f in found)
+    assert any("try_acquire" in f.message for f in found)
+
+
+def test_lease_isolation_manifest_content_true_positive(tmp_path):
+    """Lease state stored into manifest/ledger builder content."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/resilience/leases.py": _LEASE_FIXTURE,
+        "lddl_tpu/balance/census.py": """
+            from ..resilience import leases
+
+            def build_manifest_entry(root, unit):
+                lease = leases.read_lease(root, unit)
+                entry = {}
+                entry["holder"] = lease["holder"]
+                return entry
+        """,
+    })
+    found = _lease_findings(report)
+    assert any(f.path == "lddl_tpu/balance/census.py" for f in found)
+
+
+def test_lease_isolation_control_flow_only_is_silent(tmp_path):
+    """Using a lease to DECIDE (claim check, fence branch) is the whole
+    point; only data flows into published bytes may fire."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/resilience/leases.py": _LEASE_FIXTURE,
+        "lddl_tpu/resilience/io.py": """
+            def atomic_write(path, data):
+                return None
+        """,
+        "lddl_tpu/preprocess/ok.py": """
+            from ..resilience import leases
+            from ..resilience.io import atomic_write
+
+            def guarded_publish(root, unit, out, data):
+                lease = leases.try_acquire(root, unit, "h", 5.0)
+                if lease is not None:
+                    atomic_write(out, data)
+        """,
+    })
+    assert _lease_findings(report) == []
+
+
+def test_lease_isolation_lease_module_writes_exempt(tmp_path):
+    """The lease module's own publishes ARE lease files — exempt at the
+    engine level, so no caller-side or module-side finding fires for the
+    protocol's own I/O."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/resilience/leases.py": """
+            from .io import atomic_write
+
+            def renew(root, unit, holder, epoch, deadline):
+                rec = "{}:{}:{}".format(holder, epoch, deadline)
+                atomic_write(root + "/" + unit, rec)
+        """,
+        "lddl_tpu/resilience/io.py": """
+            def atomic_write(path, data):
+                return None
+        """,
+        "lddl_tpu/preprocess/user.py": """
+            from ..resilience import leases
+
+            def keep_alive(root, unit):
+                leases.renew(root, unit, "h", 1, 2.0)
+        """,
+    }, rules=["lease-isolation"])
+    assert _lease_findings(report) == []
+
+
+def test_lease_isolation_suppression_applies(tmp_path):
+    """The one sanctioned epoch-into-record flow pattern (steal.py's
+    fence record) silences with a why-commented inline suppression, like
+    every other rule."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/resilience/leases.py": _LEASE_FIXTURE,
+        "lddl_tpu/resilience/io.py": """
+            def atomic_write(path, data):
+                return None
+        """,
+        "lddl_tpu/preprocess/steal.py": """
+            from ..resilience import leases
+            from ..resilience.io import atomic_write
+
+            def journal(root, unit, out):
+                lease = leases.try_acquire(root, unit, "h", 5.0)
+                # The record IS the epoch fence for spool bytes.
+                atomic_write(out, str(lease["epoch"]))  # lddl: disable=lease-isolation,wall-clock-flow
+        """,
+    })
+    assert _lease_findings(report) == []
+    assert any(f.rule == "lease-isolation" for f in report.suppressed)
+
+
 # ------------------------------------------------- framework integration
 
 

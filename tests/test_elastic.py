@@ -1,0 +1,424 @@
+"""Elastic work-stealing preprocess (lddl_tpu/preprocess/steal.py):
+byte-identity vs the pinned goldens, multi-host concurrency, dead-host
+reclamation, fencing, and failure/resume semantics. In-process and fast
+(threads stand in for hosts — the protocol is pure filesystem, so thread
+vs process changes nothing); the real SIGKILL chaos runs in
+tests/test_chaos.py (-m slow).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+import sys
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu import observability as obs  # noqa: E402
+from lddl_tpu.preprocess.runner import run_sharded_pipeline  # noqa: E402
+from lddl_tpu.preprocess import steal  # noqa: E402
+from lddl_tpu.resilience import leases  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("elastic")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    return str(td), corpus, vocab
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    with open(gs.GOLDEN_FILE) as f:
+        return json.load(f)
+
+
+def _bert_processor(vocab, out_dir):
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    from lddl_tpu.preprocess.runner import BertBucketProcessor
+    tok = get_tokenizer(vocab_file=vocab)
+    # schema_version=1: compared against the pinned v1 goldens (elastic
+    # scheduling is schema-independent).
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True,
+                             schema_version=1)
+    return BertBucketProcessor(tok, cfg, 4242, out_dir, 8, "parquet")
+
+
+_RUN_KW = dict(num_blocks=12, sample_ratio=0.9, seed=4242,
+               global_shuffle=True, progress_interval=0.0)
+
+
+def _run_elastic(corpus, out, proc, holder, ttl=5.0, **kw):
+    return run_sharded_pipeline({"wikipedia": corpus}, out, proc,
+                                elastic=True, lease_ttl=ttl,
+                                holder_id=holder, **dict(_RUN_KW, **kw))
+
+
+def test_single_elastic_host_matches_golden(fixture_dirs, goldens, tmp_path):
+    """One elastic host == the static single-host bytes (the pinned
+    goldens), manifest included, with all scheduling state cleaned up."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    written = _run_elastic(corpus, out, _bert_processor(vocab, out), "solo")
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    assert not os.path.isdir(os.path.join(out, "_leases"))
+    assert not os.path.isdir(os.path.join(out, "_done"))
+    assert not os.path.isdir(os.path.join(out, "_shuffle"))
+    assert written and sum(written.values()) > 0
+
+
+def test_two_elastic_hosts_split_work_byte_identical(fixture_dirs, goldens,
+                                                     tmp_path):
+    """Two concurrent hosts (threads over the same shared dir — the
+    protocol is pure FS) divide the units via leases and produce the
+    golden bytes; both return the same GLOBAL census."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    # Processors built before the threads start (transformers' lazy
+    # import machinery is not concurrent-first-import safe; real elastic
+    # hosts are separate processes).
+    procs = {h: _bert_processor(vocab, out) for h in ("hostA", "hostB")}
+    results, errors = {}, {}
+
+    def host(hid, delay):
+        time.sleep(delay)
+        try:
+            results[hid] = _run_elastic(corpus, out, procs[hid], hid)
+        except Exception as e:  # noqa: BLE001 - surfaced via assert
+            errors[hid] = e
+
+    threads = [threading.Thread(target=host, args=("hostA", 0.0)),
+               threading.Thread(target=host, args=("hostB", 0.1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    assert results["hostA"] == results["hostB"]  # same global census
+
+
+def test_dead_host_units_are_reclaimed(fixture_dirs, goldens, tmp_path):
+    """A 'dead host' left expired leases, a missing scatter record with
+    partial spool appends, a partial bucket output and atomic-write
+    debris; a surviving host joining the directory steals every unit,
+    sweeps the wreckage, and still produces the goldens."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+
+    # Phase 1 — produce a faithful "cluster died mid-gather" state with a
+    # REAL elastic run whose gather units all fail: fingerprint manifest
+    # and scatter records in place, spool on disk, zero gather ledgers.
+    flag_never = str(tmp_path / "never")
+
+    class FailAlways:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def fingerprint(self):
+            return self.inner.fingerprint()
+
+        def __call__(self, texts, bucket):
+            if not os.path.exists(flag_never):
+                raise RuntimeError("host dies before finishing any bucket")
+            return self.inner(texts, bucket)
+
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out, FailAlways(_bert_processor(vocab, out)),
+                     "deadhost", ttl=0.3)
+    assert os.path.exists(os.path.join(out, "_done", "manifest.json"))
+
+    # Phase 2 — plant mid-unit wreckage exactly as a SIGKILLed holder
+    # leaves it: unreleased (now expired) leases, a scatter slice whose
+    # record is gone but whose partial appends remain, a torn bucket
+    # output and its atomic-write temp.
+    root = leases.lease_root(out)
+    dead = "deadhost2"
+    assert leases.try_acquire(root, "group-2", dead, ttl_s=0.01) is not None
+    os.remove(os.path.join(out, "_done", "scatter-0.json"))
+    assert leases.try_acquire(root, "scatter-0", dead,
+                              ttl_s=0.01) is not None
+    gdir = os.path.join(out, "_shuffle", "group-2")
+    with open(os.path.join(gdir, steal.spool_name(0, 0, dead)), "w") as f:
+        f.write("#B 0 2\n torn partial append from a dead host\n")
+    with open(os.path.join(out, "part.2.parquet_1"), "wb") as f:
+        f.write(b"torn parquet bytes")
+    with open(os.path.join(out, "part.2.parquet_1.tmp.999"), "wb") as f:
+        f.write(b"tmp debris")
+    time.sleep(0.05)  # both planted leases now expired
+
+    # Phase 3 — a survivor joins (no --resume needed: the fingerprint
+    # manifest proves the directory belongs to this plan), reclaims, and
+    # finishes byte-identically.
+    with open(flag_never, "w") as f:
+        f.write("alive\n")
+    _run_elastic(corpus, out,
+                 FailAlways(_bert_processor(vocab, out)), "survivor")
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    assert not os.path.exists(os.path.join(out, "part.2.parquet_1.tmp.999"))
+    assert not os.path.isdir(os.path.join(out, "_shuffle"))
+
+
+def test_fence_rejects_stolen_unit_and_unit_is_redone(fixture_dirs, goldens,
+                                                      tmp_path, monkeypatch):
+    """Force the stall-steal-fence sequence deterministically: the first
+    gather unit this host runs gets its lease overwritten mid-unit (as a
+    thief would after the TTL). The host must discard that attempt
+    (fence reject counted), redo nothing itself (the 'thief' is then
+    expired and the unit reclaimed at a higher epoch), and the final
+    bytes must still match the goldens."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    inner = _bert_processor(vocab, out)
+    state = {"stolen": False, "calls": 0}
+    root = leases.lease_root(out)
+
+    class StealOnce:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def fingerprint(self):
+            return self.inner.fingerprint()
+
+        def __call__(self, texts, bucket):
+            state["calls"] += 1
+            if not state["stolen"]:
+                state["stolen"] = True
+                # Thief overwrites this unit's lease at a bumped epoch
+                # with an ALREADY-EXPIRED deadline: the fence rejects our
+                # publish, and the next scan steals it back and redoes it.
+                group = bucket % 12  # ngroups == nbuckets == 12 here
+                cur = leases.read_lease(root, "group-{}".format(group))
+                assert cur is not None
+                leases._publish(
+                    leases.lease_path(root, "group-{}".format(group)),
+                    leases._record("group-{}".format(group), "thief",
+                                   cur["epoch"] + 1, 0.0), "thief")
+            return self.inner(texts, bucket)
+
+    monkeypatch.setenv("LDDL_TPU_METRICS_DIR", str(tmp_path / "metrics"))
+    obs.registry().reset()
+    written = _run_elastic(corpus, out, StealOnce(inner), "victim", ttl=5.0)
+    assert state["stolen"]
+    assert state["calls"] >= 13  # 12 buckets + at least the redone one
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    assert written and sum(written.values()) > 0
+    rejects = obs.registry().counter("lease_fence_rejects_total").total()
+    assert rejects >= 1
+
+
+def test_elastic_failed_unit_resume(fixture_dirs, goldens, tmp_path):
+    """A unit that raises on every host fails the run with the standard
+    resume message; a later elastic resume (failure cleared) completes
+    byte-identically."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "fixed.flag")
+
+    class FailOnce:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def fingerprint(self):
+            return self.inner.fingerprint()
+
+        def __call__(self, texts, bucket):
+            if bucket == 3 and not os.path.exists(flag):
+                raise RuntimeError("injected failure for bucket 3")
+            return self.inner(texts, bucket)
+
+    proc = FailOnce(_bert_processor(vocab, out))
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out, proc, "hostA", ttl=0.5)
+    # Completed units are journaled; the failed one is not.
+    done = os.listdir(os.path.join(out, "_done"))
+    assert any(n.startswith("group-") for n in done)
+    assert not os.path.exists(os.path.join(out, "_done", "group-3.json"))
+
+    with open(flag, "w") as f:
+        f.write("ok\n")
+    _run_elastic(corpus, out, proc, "hostA", ttl=5.0, resume=True)
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_elastic_refuses_mismatched_plan(fixture_dirs, tmp_path):
+    """A second host joining with different arguments (a different unit
+    plan) must refuse loudly, exactly like a mismatched resume."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _bert_processor(vocab, out)
+    flag_never = str(tmp_path / "never")
+
+    class FailAlways:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def fingerprint(self):
+            return self.inner.fingerprint()
+
+        def __call__(self, texts, bucket):
+            if not os.path.exists(flag_never):
+                raise RuntimeError("keep the run unfinished")
+            return self.inner(texts, bucket)
+
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out, FailAlways(proc), "hostA", ttl=0.5)
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline(
+            {"wikipedia": corpus}, out, proc, elastic=True, lease_ttl=5.0,
+            holder_id="hostB", **dict(_RUN_KW, num_blocks=24))
+    # Elastic and static layouts are mutually exclusive per directory.
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc,
+                             resume=True, **_RUN_KW)
+
+
+def test_elastic_rejects_multihost_comm(fixture_dirs, tmp_path):
+    from lddl_tpu.parallel.distributed import ThreadGroupCommunicator
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    proc = _bert_processor(vocab, out)
+    shared = ThreadGroupCommunicator._Shared(2)
+    comm = ThreadGroupCommunicator(0, 2, shared)
+    with pytest.raises(ValueError, match="elastic"):
+        run_sharded_pipeline({"wikipedia": corpus}, out, proc,
+                             elastic=True, comm=comm, **_RUN_KW)
+
+
+class _DropAndLog:
+    """Picklable: returns a legitimately-EMPTY result ({}) for one bucket
+    (a zero-sample unit journals `{}`) and appends every processed bucket
+    id to a log file, so a resume can prove which units were redone."""
+
+    def __init__(self, inner, drop_bucket, log_path, fail_bucket=None,
+                 fail_flag=None):
+        self.inner = inner
+        self.drop_bucket = drop_bucket
+        self.log_path = log_path
+        self.fail_bucket = fail_bucket
+        self.fail_flag = fail_flag
+
+    def fingerprint(self):
+        return self.inner.fingerprint()
+
+    def __call__(self, texts, bucket):
+        with open(self.log_path, "a") as f:
+            f.write("{}\n".format(bucket))
+        if self.fail_bucket == bucket and not os.path.exists(self.fail_flag):
+            raise RuntimeError("injected failure for bucket {}".format(
+                bucket))
+        if bucket == self.drop_bucket:
+            return {}
+        return self.inner(texts, bucket)
+
+
+def test_empty_unit_record_reads_as_done(fixture_dirs, tmp_path):
+    """A gather unit whose buckets produce zero samples journals an empty
+    {} record — which must read as DONE: an elastic resume may not redo
+    it (done-ness is record existence, not record truthiness), and the
+    final bytes must match a static run of the same plan."""
+    td, corpus, vocab = fixture_dirs
+    static_out = str(tmp_path / "static")
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "fixed.flag")
+    ref_log = str(tmp_path / "ref.log")
+    run1_log = str(tmp_path / "run1.log")
+    resume_log = str(tmp_path / "resume.log")
+
+    run_sharded_pipeline(
+        {"wikipedia": corpus}, static_out,
+        _DropAndLog(_bert_processor(vocab, static_out), 5, ref_log),
+        **_RUN_KW)
+
+    # Elastic run 1: bucket 5 journals {}, bucket 7 fails -> run raises
+    # with _done intact (bucket 5's empty record among it).
+    proc = _DropAndLog(_bert_processor(vocab, out), 5, run1_log,
+                       fail_bucket=7, fail_flag=flag)
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out, proc, "hostA", ttl=0.5)
+    assert os.path.exists(os.path.join(out, "_done", "group-5.json"))
+
+    with open(flag, "w") as f:
+        f.write("ok\n")
+    proc = _DropAndLog(_bert_processor(vocab, out), 5, resume_log,
+                       fail_bucket=7, fail_flag=flag)
+    _run_elastic(corpus, out, proc, "hostA", ttl=5.0, resume=True)
+    redone = set(int(x) for x in open(resume_log).read().split())
+    assert 5 not in redone, "empty-record unit was redone on resume"
+    assert 7 in redone
+    assert gs.hash_outputs(out) == gs.hash_outputs(static_out)
+
+
+def test_finalize_with_stale_retired_ledger_dir(fixture_dirs, goldens,
+                                                tmp_path):
+    """A finalizer that died between its ledger rename and rmtree leaves
+    `_done.retired.<holder>` behind; a later run reusing the SAME holder
+    id must still retire the live ledger — the rename onto the existing
+    dir would fail ENOTEMPTY, which must not be mistaken for 'already
+    retired by someone else' (that would leave `_done/` in the finished
+    dataset forever)."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    stale = os.path.join(out, "_done.retired.hostA")
+    os.makedirs(stale)
+    with open(os.path.join(stale, "group-0.json"), "w") as f:
+        f.write("{}")
+    _run_elastic(corpus, out, _bert_processor(vocab, out), "hostA")
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    assert not os.path.isdir(os.path.join(out, "_done"))
+    assert not any(n.startswith("_done.retired")
+                   for n in sorted(os.listdir(out)))
+
+
+class _KillWorkerOnce:
+    """Picklable: SIGKILLs its own pool-worker process for one bucket on
+    the first attempt (flag file marks the kill as spent)."""
+
+    def __init__(self, inner, kill_bucket, flag_path):
+        self.inner = inner
+        self.kill_bucket = kill_bucket
+        self.flag_path = flag_path
+
+    def fingerprint(self):
+        return self.inner.fingerprint()
+
+    def __call__(self, texts, bucket):
+        if bucket == self.kill_bucket and not os.path.exists(self.flag_path):
+            import signal
+            with open(self.flag_path, "w") as f:
+                f.write("killed\n")
+            os.kill(os.getpid(), signal.SIGKILL)
+        return self.inner(texts, bucket)
+
+
+def test_elastic_pool_worker_death_is_reclaimed(fixture_dirs, goldens,
+                                                tmp_path):
+    """Elastic claim loop over a local spawn pool (num_workers=2) with a
+    pool worker SIGKILLed mid-unit: in-flight leases are released, the
+    pool is rebuilt, the killed unit is re-claimed and re-done, and the
+    output still matches the goldens."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag = str(tmp_path / "killed.flag")
+    proc = _KillWorkerOnce(_bert_processor(vocab, out), 5, flag)
+    _run_elastic(corpus, out, proc, "poolhost", ttl=5.0, num_workers=2)
+    assert os.path.exists(flag)  # the kill really happened
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_elastic_no_global_shuffle(fixture_dirs, goldens, tmp_path):
+    """Elastic block mode (no scatter phase): blocks are the units."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    static_out = str(tmp_path / "static")
+    proc = _bert_processor(vocab, out)
+    sproc = _bert_processor(vocab, static_out)
+    kw = dict(_RUN_KW, global_shuffle=False)
+    run_sharded_pipeline({"wikipedia": corpus}, static_out, sproc, **kw)
+    run_sharded_pipeline({"wikipedia": corpus}, out, proc, elastic=True,
+                         lease_ttl=5.0, holder_id="solo", **kw)
+    assert gs.hash_outputs(out) == gs.hash_outputs(static_out)

@@ -1,0 +1,239 @@
+"""The lease protocol (lddl_tpu/resilience/leases.py): acquire / renew /
+expiry / epoch-bump steal races, fencing, the keeper thread, and the
+torn-read degradation. Pure-filesystem tests — fast, tier-1.
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from lddl_tpu.resilience import faults, leases
+
+
+@pytest.fixture
+def root(tmp_path):
+    return str(tmp_path / "leases")
+
+
+# ------------------------------------------------------------- acquisition
+
+
+def test_fresh_acquire_epoch_zero(root):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    assert lease is not None
+    assert lease.epoch == 0 and lease.holder == "hostA"
+    rec = leases.read_lease(root, "u0")
+    assert rec["holder"] == "hostA" and rec["epoch"] == 0
+    assert rec["deadline"] > time.time()
+
+
+def test_live_lease_refuses_second_claimant(root):
+    assert leases.try_acquire(root, "u0", "hostA", ttl_s=10.0) is not None
+    assert leases.try_acquire(root, "u0", "hostB", ttl_s=10.0) is None
+    # Even the same holder id is a conflict: a respawned process must not
+    # adopt its dead predecessor's lease mid-TTL.
+    assert leases.try_acquire(root, "u0", "hostA", ttl_s=10.0) is None
+
+
+def test_concurrent_fresh_acquire_exactly_one_winner(root):
+    """N threads race the exclusive create; os.link semantics guarantee
+    exactly one winner."""
+    winners, barrier = [], threading.Barrier(8)
+
+    def claim(i):
+        barrier.wait()
+        lease = leases.try_acquire(root, "u0", "host{}".format(i),
+                                   ttl_s=10.0)
+        if lease is not None:
+            winners.append(lease)
+
+    threads = [threading.Thread(target=claim, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(winners) == 1
+
+
+# ------------------------------------------------------- renew/expiry/steal
+
+
+def test_renew_extends_deadline_same_epoch(root):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=0.5)
+    d0 = lease.deadline
+    time.sleep(0.05)
+    leases.renew(lease, ttl_s=10.0)
+    assert lease.epoch == 0
+    assert lease.deadline > d0
+    assert leases.verify(lease)
+
+
+def test_expired_lease_is_stolen_with_epoch_bump(root):
+    lease_a = leases.try_acquire(root, "u0", "hostA", ttl_s=0.1)
+    assert lease_a is not None
+    time.sleep(0.15)
+    lease_b = leases.try_acquire(root, "u0", "hostB", ttl_s=10.0)
+    assert lease_b is not None
+    assert lease_b.epoch == 1 and lease_b.holder == "hostB"
+
+
+def test_fence_two_claimants_one_winner(root):
+    """The epoch-bump race resolved by the fence: A steals, B overwrites
+    at the same bump — the LAST write wins and exactly one fence check
+    passes (the losing holder must self-terminate its unit)."""
+    stale = leases.try_acquire(root, "u0", "old", ttl_s=0.05)
+    assert stale is not None
+    time.sleep(0.1)
+    lease_a = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    assert lease_a is not None and lease_a.epoch == 1
+    # B replays the same steal A just won (simulating the replace race:
+    # B read the expired epoch-0 record before A's replace landed).
+    leases._publish(leases.lease_path(root, "u0"),
+                    leases._record("u0", "hostB", 1,
+                                   time.time() + 10.0), "hostB")
+    assert not leases.verify(lease_a)  # A lost: same epoch, other holder
+    with pytest.raises(leases.LeaseLost):
+        leases.renew(lease_a, ttl_s=10.0)
+    assert lease_a.lost
+
+
+def test_stale_epoch_fence_rejects_resurrected_holder(root):
+    """A stalled holder resurrects after a steal: its (holder, epoch) no
+    longer match, verify() is False, renew() raises."""
+    zombie = leases.try_acquire(root, "u0", "zombie", ttl_s=0.05)
+    time.sleep(0.1)
+    thief = leases.try_acquire(root, "u0", "thief", ttl_s=10.0)
+    assert thief.epoch == zombie.epoch + 1
+    assert leases.verify(thief)
+    assert not leases.verify(zombie)
+    assert not leases.verify_at(zombie.root, zombie.unit, zombie.holder,
+                                zombie.epoch)
+    with pytest.raises(leases.LeaseLost):
+        leases.renew(zombie, ttl_s=10.0)
+
+
+def test_release_then_fresh_reacquire(root):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    leases.release(lease)
+    assert leases.read_lease(root, "u0") is None
+    fresh = leases.try_acquire(root, "u0", "hostB", ttl_s=10.0)
+    assert fresh is not None and fresh.epoch == 0
+
+
+def test_release_is_fenced(root):
+    """A zombie's release must not unlink the thief's lease."""
+    zombie = leases.try_acquire(root, "u0", "zombie", ttl_s=0.05)
+    time.sleep(0.1)
+    thief = leases.try_acquire(root, "u0", "thief", ttl_s=10.0)
+    leases.release(zombie)  # verify fails -> no unlink
+    assert leases.verify(thief)
+
+
+# ------------------------------------------------------- torn reads, keeper
+
+
+def test_torn_lease_reads_as_expired_and_is_stolen(root):
+    os.makedirs(root)
+    with open(leases.lease_path(root, "u0"), "w") as f:
+        f.write('{"holder": "hostA", "ep')  # torn mid-write by flaky FS
+    rec = leases.read_lease(root, "u0")
+    assert rec["torn"] and rec["deadline"] == 0.0
+    lease = leases.try_acquire(root, "u0", "hostB", ttl_s=10.0)
+    assert lease is not None and lease.epoch == 1
+
+
+def test_keeper_renews_until_stopped(root):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=0.4)
+    keeper = leases.LeaseKeeper(0.4)
+    keeper.add(lease)
+    try:
+        time.sleep(1.0)  # several TTLs: only renewals keep it alive
+        assert leases.verify(lease)
+        assert not lease.lost
+    finally:
+        keeper.stop()
+
+
+def test_keeper_marks_stolen_lease_lost(root):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=0.4)
+    keeper = leases.LeaseKeeper(0.4)
+    keeper.add(lease)
+    try:
+        # Thief overwrites: next renewal must discover the loss.
+        leases._publish(leases.lease_path(root, "u0"),
+                        leases._record("u0", "thief", lease.epoch + 1,
+                                       time.time() + 30.0), "thief")
+        deadline = time.time() + 3.0
+        while not lease.lost and time.time() < deadline:
+            time.sleep(0.05)
+        assert lease.lost
+        assert not leases.verify(lease)
+    finally:
+        keeper.stop()
+
+
+# ------------------------------------------------------------- fault sites
+
+
+def test_lease_acquire_fault_site_injects(root):
+    faults.arm("lease-acquire:eio:nth=1")
+    try:
+        with pytest.raises(OSError):
+            leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    finally:
+        faults.disarm()
+    assert leases.try_acquire(root, "u0", "hostA", ttl_s=10.0) is not None
+
+
+def test_stall_fault_freezes_renewal_past_deadline(root):
+    """The chaos scenario the fence exists for, in miniature: a stall at
+    the lease-renew site outlives the TTL, a thief steals, and the
+    stalled holder's renewal comes back LeaseLost."""
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=0.3)
+    faults.arm("lease-renew:stall:nth=1:delay=0.5")
+    try:
+        stolen = {}
+
+        def thief():
+            deadline = time.time() + 3.0
+            while time.time() < deadline:
+                got = leases.try_acquire(root, "u0", "thief", ttl_s=10.0)
+                if got is not None:
+                    stolen["lease"] = got
+                    return
+                time.sleep(0.02)
+
+        t = threading.Thread(target=thief)
+        t.start()
+        with pytest.raises(leases.LeaseLost):
+            leases.renew(lease, ttl_s=0.3)  # stalls 0.5s, then finds theft
+        t.join()
+    finally:
+        faults.disarm()
+    assert stolen["lease"].epoch == lease.epoch + 1
+
+
+def test_stall_kind_parses_with_long_default_delay():
+    clause = faults._parse_clause("lease-renew:stall:nth=1", 0)
+    assert clause["kind"] == "stall" and clause["delay"] == 30.0
+    clause = faults._parse_clause("lease-renew:stall:nth=1:delay=2.5", 0)
+    assert clause["delay"] == 2.5
+
+
+def test_holder_sanitization():
+    assert leases.sanitize_holder("host a/b:1") == "host-a-b-1"
+    with pytest.raises(ValueError):
+        leases.sanitize_holder("///")
+    h = leases.default_holder()
+    assert h == leases.sanitize_holder(h)  # already file-name safe
+
+
+def test_lease_record_roundtrip(root):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    with open(lease.path) as f:
+        rec = json.load(f)
+    assert set(rec) == {"unit", "holder", "epoch", "deadline"}
+    assert rec["unit"] == "u0"
