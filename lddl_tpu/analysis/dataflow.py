@@ -264,10 +264,15 @@ class _Extractor(object):
         self.module = module
         self.facts = facts  # None at module top level
         self.cls = cls
+        # Builder contexts whose content is resume-compared byte-for-byte:
+        # manifest/ledger (PR 4) plus the ingest record builders (journal
+        # segments, intake records, generation meta) — keep in sync with
+        # rules.ManifestDeterminismRule.NAME_TOKENS.
         self._manifest_ctx = bool(
             facts is not None
-            and ("manifest" in facts.name.lower()
-                 or "ledger" in facts.name.lower()))
+            and any(tok in facts.name.lower()
+                    for tok in ("manifest", "ledger", "journal", "intake",
+                                "generation")))
 
     # ------------------------------------------------------- statements
 

@@ -23,7 +23,7 @@ from . import dataflow
 # must only publish through resilience.io.
 SHARD_PKGS = ("lddl_tpu/preprocess/*", "lddl_tpu/balance/*",
               "lddl_tpu/loader/*", "lddl_tpu/resilience/*",
-              "lddl_tpu/utils/fs.py")
+              "lddl_tpu/ingest/*", "lddl_tpu/utils/fs.py")
 
 # The sanctioned atomic publisher: its internals ARE the tmp+fsync+replace
 # dance, and effects never propagate out of it.

@@ -101,7 +101,7 @@ _MOVE_FUNCS = frozenset({"os.replace", "os.rename", "os.renames",
 # there can leave a torn file that a resume or a reader will trust.
 _SHARD_PKGS = ("lddl_tpu/preprocess/*", "lddl_tpu/balance/*",
                "lddl_tpu/loader/*", "lddl_tpu/resilience/*",
-               "lddl_tpu/utils/fs.py")
+               "lddl_tpu/ingest/*", "lddl_tpu/utils/fs.py")
 
 
 def _open_mode(node):
@@ -413,20 +413,27 @@ _NONDET_IN_MANIFEST = frozenset(
 @register
 class ManifestDeterminismRule(Rule):
     id = "manifest-determinism"
-    doc = ("functions that build .manifest.json / ledger content must not "
-           "draw wall-clock, pids, uuids, or RNG — resume compares these "
-           "bytes across runs and ranks")
+    doc = ("functions that build .manifest.json / ledger / ingest-journal "
+           "content must not draw wall-clock, pids, uuids, or RNG — "
+           "resume compares these bytes across runs and ranks, and the "
+           "ingest journal additionally promises content-hash-only "
+           "document identity")
     # Lease records legitimately carry wall-clock deadlines and per-host
     # ids; they are scheduling state under _leases/, never resume-compared
     # content (the lease-isolation flow rule guards the real boundary).
     allow = ("lddl_tpu/resilience/leases.py",)
+
+    # Builder-name tokens this rule guards: manifest/ledger (PR 4) plus
+    # the streaming-ingestion record builders (journal segments, intake
+    # records, generation meta).
+    NAME_TOKENS = ("manifest", "ledger", "journal", "intake", "generation")
 
     def run(self, ctx):
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.FunctionDef):
                 continue
             lowered = node.name.lower()
-            if "manifest" not in lowered and "ledger" not in lowered:
+            if not any(tok in lowered for tok in self.NAME_TOKENS):
                 continue
             for call in ast.walk(node):
                 if not isinstance(call, ast.Call):

@@ -1,0 +1,9 @@
+"""Streaming ingestion: incremental preprocess + delta balance as a
+long-lived service over a growing corpus (see journal.py and
+incremental.py for the design)."""
+
+from .incremental import ingest_once, watch
+from .journal import Journal, diff_landing, doc_content_hash
+
+__all__ = ["Journal", "diff_landing", "doc_content_hash", "ingest_once",
+           "watch"]

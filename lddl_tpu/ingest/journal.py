@@ -1,0 +1,325 @@
+"""Intake journal: the durable record of which documents were ingested.
+
+The streaming-ingestion service turns the four offline stages into a
+long-lived loop: scan a landing directory (or an explicit file list),
+diff it against this journal, and preprocess only the delta. The journal
+is keyed by **content hash** — a document's identity is its bytes, never
+its path, mtime, or position in the landing directory — so re-delivered
+files, renamed files, and duplicate documents all diff to nothing.
+
+Durability layout under ``<root>/.ingest/``::
+
+    journal/gen-<NNNN>.json   authoritative per-generation segments:
+                              one immutable, atomically-published record
+                              per published generation ({"generation",
+                              "fingerprint", "hashes", "carry", "docs"})
+    journal.json              compaction cache of the union (fast load);
+                              a torn cache degrades to re-scanning the
+                              segments with a warning — never a crash,
+                              and never silent trust in torn bytes
+    carry/                    carryover shards (rows journaled but not
+                              yet shard-visible; see balance/delta.py)
+    work/gen-<NNNN>/          in-flight generation scratch (staging
+                              corpus, preprocess output, balance staging)
+
+Everything here is published through ``resilience.io.atomic_write`` and
+read through retried reads, with dedicated ``journal-read`` /
+``journal-publish`` fault-injection sites so the chaos harness can tear
+and kill at exactly these records. Journal bytes are deterministic:
+content hashes and generation numbers only — no wall clock, no pids, no
+filesystem order (hash lists are sorted).
+"""
+
+import hashlib
+import json
+import logging
+import os
+
+from .. import observability as obs
+from ..resilience import faults
+from ..resilience import io as rio
+
+INGEST_DIR = ".ingest"
+JOURNAL_CACHE_NAME = "journal.json"
+SEGMENT_DIR = "journal"
+CARRY_DIR = "carry"
+WORK_DIR = "work"
+INTAKE_NAME = "intake.json"
+
+_log = logging.getLogger("lddl_tpu.ingest.journal")
+
+
+def ingest_root(root):
+    return os.path.join(root, INGEST_DIR)
+
+
+def segment_dir(root):
+    return os.path.join(ingest_root(root), SEGMENT_DIR)
+
+
+def segment_path(root, generation):
+    return os.path.join(segment_dir(root),
+                        "gen-{:04d}.json".format(generation))
+
+
+def carry_dir(root):
+    return os.path.join(ingest_root(root), CARRY_DIR)
+
+
+def work_dir(root, generation):
+    return os.path.join(ingest_root(root), WORK_DIR,
+                        "gen-{:04d}".format(generation))
+
+
+def intake_path(root, generation):
+    return os.path.join(work_dir(root, generation), INTAKE_NAME)
+
+
+def doc_content_hash(text):
+    """Stable content identity of one document: blake2b over its raw
+    bytes. The journal, staging corpus doc ids, and dedup all use this —
+    no other field of a document participates in its identity."""
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    return hashlib.blake2b(text, digest_size=16).hexdigest()
+
+
+def read_record(path):
+    """One journal record through the dedicated ``journal-read`` fault
+    site and the retried JSON reader: returns (value, status) with status
+    in {"ok", "missing", "torn"} — a truncate fault downgrades an
+    otherwise-clean read to "torn", like flaky storage would."""
+    action = faults.fault_point("journal-read", path)
+    rec, status = rio.read_json(path)
+    if action == "truncate" and status == "ok":
+        return None, "torn"
+    return rec, status
+
+
+def publish_record(path, payload):
+    """Atomically publish one journal record (``journal-publish`` fault
+    site). ``payload`` must already be deterministic content — every
+    caller serializes with sort_keys."""
+    faults.fault_point("journal-publish", path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    rio.atomic_write(path, json.dumps(payload, sort_keys=True))
+
+
+class Journal:
+    """The loaded union of all published generation segments.
+
+    ``entries``: {doc_hash: generation}; ``generation``: latest published
+    generation (-1 when nothing was ever published); ``fingerprint``: the
+    processor digest every generation must match (config drift across
+    generations would mix incompatible shard bytes in one directory);
+    ``carry``: {bin_key: carry_file_basename} for rows journaled but not
+    yet visible as shards.
+    """
+
+    def __init__(self, root, entries=None, generation=-1, fingerprint=None,
+                 carry=None):
+        self.root = root
+        self.entries = entries or {}
+        self.generation = generation
+        self.fingerprint = fingerprint
+        self.carry = carry or {}
+
+    # ------------------------------------------------------------- load
+
+    @classmethod
+    def load(cls, root):
+        """Load the journal: cache fast path, segment re-scan fallback.
+
+        A torn cache (flaky storage serving half a file — the writer is
+        atomic) degrades to re-scanning the per-generation segments with
+        a warning, mirroring the torn-lease/torn-ledger handling: torn
+        bytes are never trusted and never fatal. A torn *segment* IS
+        fatal — segments are the ground truth, and guessing at missing
+        ingested-document hashes would silently re-ingest (duplicate)
+        data."""
+        cache_path = os.path.join(ingest_root(root), JOURNAL_CACHE_NAME)
+        rec, status = read_record(cache_path)
+        if status == "ok" and cls._cache_valid(rec):
+            return cls(root, entries=dict(rec["entries"]),
+                       generation=int(rec["generation"]),
+                       fingerprint=rec.get("fingerprint"),
+                       carry=dict(rec.get("carry") or {}))
+        if status == "torn" or (status == "ok" and not cls._cache_valid(rec)):
+            _log.warning(
+                "torn/unparseable journal cache %s; re-scanning the "
+                "per-generation segments (the cache is a compaction — "
+                "segments are authoritative)", cache_path)
+            obs.inc("ingest_journal_rescans_total")
+        return cls._load_from_segments(root)
+
+    @staticmethod
+    def _cache_valid(rec):
+        return (isinstance(rec, dict)
+                and isinstance(rec.get("entries"), dict)
+                and isinstance(rec.get("generation"), int))
+
+    @classmethod
+    def _load_from_segments(cls, root):
+        seg_dir = segment_dir(root)
+        journal = cls(root)
+        if not os.path.isdir(seg_dir):
+            return journal
+        seen = set()
+        for name in sorted(os.listdir(seg_dir)):
+            path = os.path.join(seg_dir, name)
+            rec, status = read_record(path)
+            if status == "missing":
+                continue
+            if status == "torn" or not isinstance(rec, dict) \
+                    or "generation" not in rec:
+                raise ValueError(
+                    "journal segment {} is torn or unparseable; segments "
+                    "are the authoritative ingest record and are written "
+                    "atomically, so this implicates the storage medium — "
+                    "restore the file before ingesting (re-scanning would "
+                    "silently duplicate already-ingested documents)".format(
+                        path))
+            g = int(rec["generation"])
+            seen.add(g)
+            for h in rec.get("hashes", ()):
+                journal.entries[h] = g
+            if g > journal.generation:
+                journal.generation = g
+                journal.fingerprint = rec.get("fingerprint")
+                journal.carry = dict(rec.get("carry") or {})
+        # Generations publish strictly in sequence, so the segment set
+        # must be exactly 0..N. A hole means a LOST segment: its hashes
+        # are absent from the union, and ingesting on top would silently
+        # re-ingest (duplicate) those documents — same loud stop as a
+        # torn segment.
+        if seen and seen != set(range(journal.generation + 1)):
+            missing = sorted(set(range(journal.generation + 1)) - seen)
+            raise ValueError(
+                "journal segment(s) for generation(s) {} are missing from "
+                "{} (segments present: {}); the ingest sequence cannot "
+                "have holes — restore the lost segment(s) before "
+                "ingesting (re-scanning would silently duplicate their "
+                "documents)".format(missing, seg_dir, sorted(seen)))
+        return journal
+
+    # ---------------------------------------------------------- publish
+
+    def publish_generation(self, generation, hashes, fingerprint,
+                           carry=None, doc_bytes=0):
+        """Commit one generation: atomic segment publish (the commit
+        point — a crash before this line leaves the generation fully
+        redoable from its intake record, a crash after it leaves only
+        idempotent cleanup), then recompact the cache."""
+        if generation != self.generation + 1:
+            raise ValueError(
+                "generation {} published out of order (journal is at "
+                "{})".format(generation, self.generation))
+        payload = {
+            "generation": generation,
+            "fingerprint": fingerprint,
+            "hashes": sorted(hashes),
+            "carry": dict(carry or {}),
+            "docs": len(hashes),
+            "doc_bytes": int(doc_bytes),
+        }
+        publish_record(segment_path(self.root, generation), payload)
+        for h in hashes:
+            self.entries[h] = generation
+        self.generation = generation
+        self.fingerprint = fingerprint
+        self.carry = dict(carry or {})
+        self._write_cache()
+        obs.inc("ingest_generations_published_total")
+
+    def _write_cache(self):
+        publish_record(
+            os.path.join(ingest_root(self.root), JOURNAL_CACHE_NAME),
+            {"entries": self.entries, "generation": self.generation,
+             "fingerprint": self.fingerprint, "carry": self.carry})
+
+    # ------------------------------------------------------------- work
+
+    def next_generation(self):
+        return self.generation + 1
+
+    def pending_work(self):
+        """The intake record of a crashed, not-yet-published generation
+        (or None): its work dir exists with an intake.json whose
+        generation is exactly journal.generation + 1. Stale work dirs of
+        ALREADY-published generations (a crash between segment publish
+        and cleanup) are swept here."""
+        wroot = os.path.join(ingest_root(self.root), WORK_DIR)
+        if not os.path.isdir(wroot):
+            return None
+        pending = None
+        for name in sorted(os.listdir(wroot)):
+            path = os.path.join(wroot, name, INTAKE_NAME)
+            rec, status = read_record(path)
+            if status == "torn":
+                _log.warning(
+                    "torn intake record %s; discarding the in-flight "
+                    "generation's scratch (nothing was published, so the "
+                    "delta is simply re-detected from the landing "
+                    "directory)", path)
+                import shutil
+                shutil.rmtree(os.path.join(wroot, name), ignore_errors=True)
+                continue
+            if rec is None:
+                continue
+            g = int(rec["generation"])
+            if g <= self.generation:
+                import shutil  # published: only cleanup was interrupted
+                shutil.rmtree(os.path.join(wroot, name), ignore_errors=True)
+            elif g == self.generation + 1:
+                pending = rec
+            else:
+                raise ValueError(
+                    "work dir {} claims generation {} but the journal is "
+                    "at {}; the ingest sequence cannot skip generations "
+                    "— remove the stray work dir if it is debris".format(
+                        os.path.join(wroot, name), g, self.generation))
+        return pending
+
+
+# -------------------------------------------------------------- landing scan
+
+
+def iter_landing_documents(landing=None, files=None):
+    """Yield (content_hash, text_bytes) for every non-empty document in
+    the landing directory (downloader output contract: one document per
+    line, first token is the id) or an explicit ``files`` list. Files are
+    visited in sorted order, but the journal diff is order-insensitive by
+    construction (identity is the content hash)."""
+    from ..preprocess.readers import split_id_text
+    if (landing is None) == (files is None):
+        raise ValueError("give exactly one of landing= or files=")
+    if files is None:
+        from ..preprocess.readers import discover_source_files
+        files = discover_source_files({"landing": landing})
+    for path in sorted(files):
+        with open(path, "rb") as f:
+            for line in f:
+                line = line.rstrip(b"\n")
+                if not line.strip():
+                    continue
+                _, text = split_id_text(line)
+                if not text.strip():
+                    continue
+                yield doc_content_hash(text), text
+
+
+def diff_landing(journal, landing=None, files=None):
+    """The preprocess work set: {content_hash: text_bytes} for documents
+    in the landing set but not in the journal. Duplicate documents within
+    one scan collapse to a single entry (content identity), counted in
+    the returned stats."""
+    new_docs = {}
+    seen = dupes = 0
+    for h, text in iter_landing_documents(landing=landing, files=files):
+        seen += 1
+        if h in journal.entries or h in new_docs:
+            dupes += h in new_docs
+            continue
+        new_docs[h] = text
+    return new_docs, {"docs_seen": seen, "docs_new": len(new_docs),
+                      "dupes_in_scan": dupes}

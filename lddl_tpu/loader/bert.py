@@ -22,11 +22,87 @@ from ..utils.fs import (
     get_all_bin_ids,
     get_all_parquets_under,
     get_file_paths_for_bin_id,
+    get_generation_of_path,
 )
 from ..utils.logging import DatasetLogger
 from .dataloader import Binned, DataLoader
 from .datasets import (ParquetDataset, annotate_quarantine,
                        verified_shard_paths)
+
+
+def generation_gate_filter(root, paths):
+    """Apply the generation pickup gate: the root ``.manifest.json``'s
+    ``__meta__`` generation is the LAST thing the ingest publisher
+    writes, so shards under gen dirs newer than it are excluded even if
+    their files already exist (a generation mid-publish is never
+    visible). A directory with no generation meta (classic offline
+    output) gates nothing and follows whatever is on disk. Returns
+    (filtered_paths, gate). The startup file list and every epoch-
+    boundary refresh go through this one filter."""
+    from ..resilience.integrity import read_manifest
+    manifest = read_manifest(root)
+    meta = manifest.get("__meta__") if manifest else None
+    gate = meta.get("generation") if isinstance(meta, dict) else None
+    if gate is not None:
+        paths = [p for p in paths
+                 if get_generation_of_path(root, p) <= gate]
+    return paths, gate
+
+
+class GenerationSnapshot:
+    """One gate + directory-listing read shared by every bin's follower
+    within one epoch boundary (keyed by the boundary's epoch number), so
+    a generation publish landing between two bins' refreshes cannot give
+    a single epoch a generation-mixed view — a fresh loader started at
+    that epoch index must reproduce its batches exactly."""
+
+    def __init__(self, root):
+        self.root = root
+        self._key = None
+        self._value = None
+
+    def get(self, key):
+        if key is None or key != self._key:
+            self._value = generation_gate_filter(
+                self.root, get_all_parquets_under(self.root))
+            self._key = key
+        return self._value
+
+
+class GenerationFollower:
+    """Picklable refresh callable for generation-aware loading: returns
+    the currently-published, verified shard list for one dataset (one bin
+    or the unbinned whole; see generation_gate_filter for the visibility
+    rule)."""
+
+    def __init__(self, root, bin_id=None, on_corrupt=None, snapshot=None):
+        self.root = root
+        self.bin_id = bin_id
+        self.on_corrupt = on_corrupt
+        self.snapshot = snapshot or GenerationSnapshot(root)
+        self.last_gate = None
+        self._epoch_key = None
+        self._last = None  # (gated bin paths, verified result)
+
+    def set_epoch_key(self, key):
+        """Called by the dataset right before the refresh with the epoch
+        boundary's number — the snapshot cache key every bin shares."""
+        self._epoch_key = key
+
+    def __call__(self):
+        paths, gate = self.snapshot.get(self._epoch_key)
+        self.last_gate = gate
+        # Bin-filter BEFORE verifying (bin_id=None serves the unbinned
+        # dataset, not "all bins"), and serve an unchanged set from the
+        # memo: integrity verification is a startup/pickup contract, not
+        # a per-epoch CRC re-scan of the whole directory.
+        paths = get_file_paths_for_bin_id(paths, self.bin_id)
+        if self._last is not None and self._last[0] == paths:
+            return list(self._last[1])
+        verified = verified_shard_paths(self.root, paths,
+                                        on_corrupt=self.on_corrupt)
+        self._last = (paths, verified)
+        return list(verified)
 
 
 def _list_views(col):
@@ -530,8 +606,16 @@ def get_bert_pretrain_data_loader(
     pack_allow_uneven_epochs=False,
     worker_mode="thread",
     on_corrupt=None,
+    follow_generations=False,
 ):
     """Build the BERT pretraining loader over balanced shards at ``path``.
+
+    ``follow_generations=True`` serves a streaming-ingestion directory as
+    a growing dataset: at every epoch boundary the loader re-reads the
+    root manifest's generation gate and picks up newly published
+    ``gen-<NNNN>/`` shards without a restart (mid-epoch publishes wait
+    for the boundary; see ParquetDataset.maybe_refresh). Off by default —
+    classic directories behave exactly as before.
 
     ``on_corrupt`` sets the startup shard-integrity policy ("fail" |
     "quarantine"; None defers to LDDL_TPU_ON_CORRUPT then "fail") — shards
@@ -569,6 +653,10 @@ def get_bert_pretrain_data_loader(
     file_paths = get_all_parquets_under(path)
     if not file_paths:
         raise ValueError("no parquet shards under {}".format(path))
+    if follow_generations:
+        # The initial set obeys the same pickup gate a refresh does, so
+        # a generation mid-publish at startup is excluded consistently.
+        file_paths, _ = generation_gate_filter(path, file_paths)
     n_before = len(file_paths)
     file_paths = verified_shard_paths(path, file_paths,
                                       on_corrupt=on_corrupt, logger=logger,
@@ -609,7 +697,11 @@ def get_bert_pretrain_data_loader(
         if return_raw_samples:
             raise ValueError("return_raw_samples and packing are exclusive")
 
-    def make_dataset(paths, transform=None):
+    # One snapshot for the whole loader: every bin's follower reads the
+    # gate + listing from the same per-epoch-keyed cache.
+    gen_snapshot = GenerationSnapshot(path) if follow_generations else None
+
+    def make_dataset(paths, transform=None, bin_id=None):
         try:
             return ParquetDataset(
                 paths,
@@ -624,6 +716,10 @@ def get_bert_pretrain_data_loader(
                 transform=transform,
                 comm=comm,
                 logger=logger,
+                refresh=(GenerationFollower(path, bin_id=bin_id,
+                                            on_corrupt=on_corrupt,
+                                            snapshot=gen_snapshot)
+                         if follow_generations else None),
             )
         except ValueError as e:
             # Divisibility/balance errors after a quarantine must name
@@ -654,7 +750,8 @@ def get_bert_pretrain_data_loader(
             fixed_seq_lengths = [None] * len(bin_ids)
         loaders = [
             DataLoader(
-                make_dataset(get_file_paths_for_bin_id(file_paths, b)),
+                make_dataset(get_file_paths_for_bin_id(file_paths, b),
+                             bin_id=b),
                 batch_size,
                 collate_fn=make_collate(fixed_seq_lengths[b]),
                 prefetch=prefetch,

@@ -150,6 +150,11 @@ class DataLoader:
         self._finalizer = None
         self._pool_gen = 0
         self._epoch_active = False
+        # Generation-aware datasets bump files_version when an epoch
+        # boundary picks up newly published shards; persistent process
+        # workers hold a PICKLED dataset copy, so a version change forces
+        # a pool respawn (workers re-pickle the refreshed dataset).
+        self._seen_files_version = getattr(dataset, "files_version", 0)
         # Cumulative process-mode IPC cost: framed qserde bytes and
         # batches received over this loader's lifetime (benchmarks read
         # these to report pickle-bytes/batch; always 0 in thread mode).
@@ -424,6 +429,13 @@ class DataLoader:
         from . import qserde
         ds = self.dataset
         epoch = ds.advance_epoch()
+        version = getattr(ds, "files_version", 0)
+        if version != self._seen_files_version:
+            # The dataset picked up a new generation at this boundary:
+            # the workers' pickled copies are stale — respawn the pool so
+            # every worker re-pickles the refreshed file list.
+            self._seen_files_version = version
+            self.shutdown_workers()
         rng = getattr(self._collate_fn, "needs_rng", False)
         if self._epoch_active:
             # A previous epoch's iterator is still mid-stream on the
@@ -690,6 +702,14 @@ class Binned:
 
     def __iter__(self):
         self._epoch += 1
+        # Refresh every bin dataset BEFORE sizing the epoch: the
+        # remaining-sample bookkeeping below and each bin's own epoch
+        # advance must agree on one file set (maybe_refresh is once per
+        # epoch, so the advance inside iter(dl) will not refresh again).
+        for dl in self._dataloaders:
+            refresh = getattr(dl.dataset, "maybe_refresh", None)
+            if refresh is not None:
+                refresh()
         world_g = lrng.world_rng(self._base_seed, self._epoch)
         remaining = [len(dl.dataset) for dl in self._dataloaders]
         iters = [iter(dl) for dl in self._dataloaders]

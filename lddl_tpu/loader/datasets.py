@@ -21,8 +21,8 @@ from ..resilience.io import read_table
 from ..utils import rng as lrng
 from ..utils.fs import (
     get_num_samples_of_parquet,
-    num_samples_cache_is_stale,
     read_num_samples_cache,
+    trusted_num_samples_entries,
 )
 from ..utils.logging import DatasetLogger
 from ..utils.types import File
@@ -154,6 +154,7 @@ class ParquetDataset:
         transform=None,
         comm=None,
         logger=None,
+        refresh=None,
     ):
         if decode_record_batch is None:
             raise ValueError("decode_record_batch is required")
@@ -178,10 +179,24 @@ class ParquetDataset:
         self._decode_record_batch = decode_record_batch
         self._transform = transform
         self._logger = logger or DatasetLogger()
+        # ``refresh``: optional picklable callable returning the CURRENT
+        # verified file list for a growing (multi-generation) directory;
+        # checked once per epoch boundary (see maybe_refresh). The comm is
+        # kept for cross-rank agreement but never pickled — process-mode
+        # workers receive refreshed file lists via a pool respawn, they
+        # never refresh themselves.
+        self._refresh = refresh
+        self._comm = comm
+        self._files_version = 0
+        self._refreshed_for = None
         self._files = self._census(sorted(file_paths),
                                    comm or LocalCommunicator())
+        self._num_samples_per_file = self._validate_counts(self._files)
 
-        counts = [f.num_samples for f in self._files]
+    def _validate_counts(self, files):
+        """The ±1 balance checks every file set must pass; returns the
+        per-file (min) count every file is truncated to."""
+        counts = [f.num_samples for f in files]
         lo, hi = min(counts), max(counts)
         if not (lo == hi or lo + 1 == hi):
             raise ValueError(
@@ -190,40 +205,69 @@ class ParquetDataset:
         if lo == 0:
             raise ValueError("input shards contain empty files")
         # Truncate to the min count so every file contributes equally.
-        self._num_samples_per_file = lo
-        lost = sum(counts) - lo * len(self._files)
+        lost = sum(counts) - lo * len(files)
         if lost:
             self._logger.to("rank").warning(
                 "dropping {} sample(s) to equalize shard counts".format(lost))
+        return lo
 
-    def _census(self, file_paths, comm):
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        # Communicators do not pickle, and pickled copies (process-mode
+        # workers) must never refresh independently: their file list is
+        # whatever the parent held at spawn, replaced wholesale by a pool
+        # respawn when the parent picks up a new generation.
+        state["_comm"] = None
+        state["_refresh"] = None
+        return state
+
+    def _census(self, file_paths, comm, on_error="raise"):
         """Per-file counts from the .num_samples.json cache; strided footer
-        reads + allreduce when the cache is missing/incomplete.
+        reads + allreduce only for entries the cache cannot vouch for.
         (ref: lddl/torch/datasets.py:161-195)
 
-        A cache whose key set mismatches the parquet basenames actually on
-        disk is STALE (e.g. a crash published it for a different shard
-        set, or shards were added/removed since): it is ignored and the
-        counts recomputed from footers, logged so the fallback is
-        visible."""
+        Trust is per entry (utils.fs.trusted_num_samples_entries): sized
+        caches — the ingest service records each shard's byte length —
+        validate entry-by-entry, so appending a generation or flushing a
+        tail recounts only the shards that actually changed. Legacy
+        caches keep the all-or-nothing key-set check; a distrusted cache
+        is logged so the fallback is visible.
+
+        The collective is shape-invariant: every rank always allreduces a
+        full-length vector, with each index contributed by exactly its
+        stride owner (cache value if it trusts the entry, footer read
+        otherwise). Trust is a LOCAL judgement — a stale NFS attribute
+        cache can make ranks disagree — so neither collective
+        participation nor the length of the vector may depend on it, and
+        every rank ends up using the identical, owner-decided counts."""
         dir_counts = {}
-        for d in {os.path.dirname(p) for p in file_paths}:
+        for d in sorted({os.path.dirname(p) for p in file_paths}):
             cached = read_num_samples_cache(d)
-            if cached is None:
-                continue
-            if num_samples_cache_is_stale(d, cached):
+            trusted, untrusted = trusted_num_samples_entries(d, cached)
+            if cached is not None and untrusted:
                 self._logger.to("rank").warning(
-                    ".num_samples.json in {} does not match the shards on "
-                    "disk; ignoring it and recomputing counts from parquet "
-                    "footers".format(d))
-                continue
-            for name, n in cached.items():
+                    ".num_samples.json in {} cannot vouch for {} shard(s); "
+                    "recomputing those counts from parquet footers".format(
+                        d, len(untrusted)))
+            for name, n in trusted.items():
                 dir_counts[os.path.join(d, name)] = n
-        if all(p in dir_counts for p in file_paths):
-            return [File(p, int(dir_counts[p])) for p in file_paths]
         counts = [0] * len(file_paths)
         for i in range(comm.rank, len(file_paths), comm.world_size):
-            counts[i] = get_num_samples_of_parquet(file_paths[i])
+            p = file_paths[i]
+            n = dir_counts.get(p)
+            if n:
+                counts[i] = int(n)
+            elif on_error == "raise":
+                counts[i] = get_num_samples_of_parquet(p)
+            else:
+                # Sentinel mode (epoch-boundary refresh): a failed footer
+                # read must not abandon the collective other ranks are
+                # already waiting in — poison the count instead; the
+                # allreduce spreads it so every rank defers identically.
+                try:
+                    counts[i] = get_num_samples_of_parquet(p)
+                except Exception:  # noqa: BLE001  lddl: disable=swallowed-error
+                    counts[i] = -(1 << 40)
         counts = comm.allreduce_sum(counts)
         return [File(p, int(n)) for p, n in zip(file_paths, counts)]
 
@@ -259,8 +303,130 @@ class ParquetDataset:
     def epoch(self):
         return self._epoch
 
+    @property
+    def files_version(self):
+        """Bumped whenever maybe_refresh changes the file set — consumers
+        holding derived state (process-worker pools with pickled dataset
+        copies) watch this to know when to rebuild."""
+        return self._files_version
+
+    def maybe_refresh(self):
+        """Pick up newly published generations at an epoch boundary.
+
+        No-op without a ``refresh`` callable (classic frozen datasets),
+        when the published file set is unchanged, or when this epoch
+        already refreshed (Binned refreshes all bins up front so its
+        remaining-sample bookkeeping and the per-bin epoch advance agree
+        on one file set). A new set must pass the same balance and
+        divisibility checks as construction — a violation defers the
+        pickup with a warning instead of killing a running service (the
+        next publish usually heals it). Returns True when the file set
+        changed. Never called mid-epoch: streams built by start_epoch /
+        worker_stream keep their file list until the next boundary."""
+        if self._refresh is None:
+            return False
+        if self._refreshed_for == self._epoch + 1:
+            return False
+        self._refreshed_for = self._epoch + 1
+        warn = self._logger.to("rank").warning
+        refresh = self._refresh
+        if hasattr(refresh, "set_epoch_key"):
+            # GenerationFollower: one shared snapshot read per epoch
+            # boundary across every bin (see loader.bert), so a publish
+            # landing between two bins' refreshes cannot give one epoch
+            # a generation-mixed view.
+            refresh.set_epoch_key(self._epoch + 1)
+        try:
+            new_paths = sorted(refresh())
+        except Exception as e:  # noqa: BLE001 - a service must not die
+            warn("generation refresh failed ({}: {}); keeping the current "
+                 "file set".format(type(e).__name__, e))
+            new_paths = None
+        comm = self._comm or LocalCommunicator()
+        if comm.world_size > 1:
+            # The agreement collective runs UNCONDITIONALLY once per
+            # boundary: participation must never depend on locally-judged
+            # state (a failed refresh, an unchanged-looking set) or the
+            # ranks' collectives desync — the same contract _census
+            # documents. From here on, every decision is a pure function
+            # of the agreed set, so verdicts stay rank-identical.
+            if not self._ranks_agree(comm, new_paths):
+                warn("generation refresh deferred: ranks observed "
+                     "different published file sets (a publish raced the "
+                     "epoch boundary); retrying next epoch")
+                return False
+        if new_paths is None:
+            return False
+        current = [f.path for f in self._files]
+        if new_paths == current:
+            return False
+        if len(new_paths) % self._num_dp_groups != 0 or (
+                len(new_paths) // self._num_dp_groups) % self._num_workers:
+            warn("generation refresh deferred: {} files not divisible by "
+                 "{} dp group(s) x {} worker(s); keeping the current "
+                 "set".format(len(new_paths), self._num_dp_groups,
+                              self._num_workers))
+            return False
+        files = self._census(new_paths, comm, on_error="sentinel")
+        if any(f.num_samples < 0 for f in files):
+            # A footer read failed on the stride owner; the sentinel rode
+            # the allreduce, so EVERY rank sees it and defers together.
+            warn("generation refresh deferred (unreadable shard footer); "
+                 "keeping the current file set")
+            return False
+        try:
+            per_file = self._validate_counts(files)
+        except ValueError as e:
+            # Pure function of the allreduced counts: rank-identical.
+            warn("generation refresh deferred ({}); keeping the current "
+                 "file set".format(e))
+            return False
+        self._files = files
+        self._num_samples_per_file = per_file
+        self._files_version += 1
+        from .. import observability as obs
+        if obs.enabled():
+            obs.inc("loader_generation_refreshes_total")
+            root = getattr(self._refresh, "root", None)
+            if root is not None:
+                from ..utils.fs import get_generation_of_path
+                loaded = max(get_generation_of_path(root, f.path)
+                             for f in self._files)
+                obs.set_gauge("loader_generations_loaded", loaded + 1)
+                gate = getattr(self._refresh, "last_gate", None)
+                if gate is not None:
+                    obs.set_gauge("loader_generation_lag", gate - loaded)
+        self._logger.to("rank").info(
+            "picked up new generation(s): {} -> {} files".format(
+                len(current), len(self._files)))
+        return True
+
+    @staticmethod
+    def _ranks_agree(comm, new_paths):
+        """All-rank agreement on the refreshed file set with the one
+        collective available (sum): every rank contributes a digest of
+        its set; agreement iff the digest variance is zero
+        (world * sum(d^2) == (sum d)^2). Both sides are built from the
+        allreduced totals only, so EVERY rank computes the identical
+        verdict — a rank-divergent refresh decision would desync the
+        SPMD epoch, which is exactly what this check exists to prevent."""
+        import zlib
+        # 28-bit digest keeps digest^2 summed over any realistic world
+        # size inside the collective's int64 contract. A failed refresh
+        # (new_paths None) contributes a sentinel OUTSIDE the digest
+        # range: all-failed still agrees (every rank then defers on the
+        # None), mixed failure disagrees, and either way every rank ran
+        # the collective.
+        digest = (1 << 28) if new_paths is None else (
+            zlib.crc32("\n".join(new_paths).encode()) & 0xFFFFFFF)
+        s1, s2 = comm.allreduce_sum([digest, digest * digest])
+        return int(s2) * comm.world_size == int(s1) * int(s1)
+
     def advance_epoch(self):
-        """Advance the epoch counter (no streams built); returns it."""
+        """Advance the epoch counter (no streams built); returns it.
+        Generation pickup happens here — the epoch boundary — so a
+        mid-epoch publish never changes a stream in flight."""
+        self.maybe_refresh()
         self._epoch += 1
         return self._epoch
 

@@ -43,11 +43,22 @@ def discover_source_files(corpus_paths):
     """Flatten {corpus_name: path} into a sorted list of input text files.
 
     Each corpus path may point either at the corpus root (containing
-    ``source/``) or directly at a directory of ``.txt`` shards.
+    ``source/``), directly at a directory of ``.txt`` shards, or be an
+    explicit list/tuple of text-file paths (the streaming-ingestion
+    service hands landing files over this way) — explicit lists are
+    sorted, so file order never depends on how the caller built them.
     """
     files = []
     for _, path in sorted(corpus_paths.items()):
         if path is None:
+            continue
+        if isinstance(path, (list, tuple)):
+            explicit = sorted(str(p) for p in path)
+            missing = [p for p in explicit if not os.path.isfile(p)]
+            if missing:
+                raise ValueError(
+                    "explicit source file(s) missing: {}".format(missing))
+            files.extend(explicit)
             continue
         source = os.path.join(path, "source")
         root = source if os.path.isdir(source) else path

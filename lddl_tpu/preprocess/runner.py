@@ -255,11 +255,17 @@ def _canonical_paths(corpus_paths):
     """``discover_source_files``'s {name: path} dict with every path
     absolutized (normpath+abspath, NO symlink resolution: realpath would
     diverge across hosts whose automounters resolve the same logical
-    path differently, spuriously refusing a multi-host resume)."""
-    return {
-        k: os.path.abspath(v) if isinstance(v, str) else str(v)
-        for k, v in sorted(corpus_paths.items())
-    }
+    path differently, spuriously refusing a multi-host resume).
+    Explicit file lists (the ingest service's form) canonicalize as the
+    sorted absolutized list."""
+    def canon(v):
+        if isinstance(v, str):
+            return os.path.abspath(v)
+        if isinstance(v, (list, tuple)):
+            return sorted(os.path.abspath(str(p)) for p in v)
+        return str(v)
+
+    return {k: canon(v) for k, v in sorted(corpus_paths.items())}
 
 
 def splitter_digest(splitter_params):
@@ -641,6 +647,7 @@ def run_sharded_pipeline(
     lease_ttl=30.0,
     holder_id=None,
     scatter_units=None,
+    emit_manifest=True,
 ):
     """Generic SPMD scaffolding shared by every preprocessor: dirty-dir
     guard -> block planning -> (optional) scatter shuffle -> strided bucket
@@ -696,7 +703,7 @@ def run_sharded_pipeline(
                 corpus_paths, out_dir, process_bucket, num_blocks,
                 sample_ratio, seed, global_shuffle, comm, log, num_workers,
                 spool_groups, resume, progress_interval, elastic,
-                lease_ttl, holder_id, scatter_units)
+                lease_ttl, holder_id, scatter_units, emit_manifest)
         finally:
             obs.flush()
 
@@ -705,7 +712,7 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
                        sample_ratio, seed, global_shuffle, comm, log,
                        num_workers, spool_groups, resume, progress_interval,
                        elastic=False, lease_ttl=30.0, holder_id=None,
-                       scatter_units=None):
+                       scatter_units=None, emit_manifest=True):
     # Refuse a dirty output dir (unless resuming): stale part files from a
     # previous run with a different block count would silently survive next
     # to fresh ones and duplicate data downstream. Elastic hosts joining a
@@ -807,6 +814,7 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
 
     if elastic:
         spec["scatter_units"] = n_scatter_units
+        spec["emit_manifest"] = bool(emit_manifest)
         from . import steal
         return steal.run_elastic_pipeline(
             spec, process_bucket, log,
@@ -925,8 +933,12 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
 
     # Integrity manifest (per-shard byte length + CRC32) for the loader's
     # startup verification. Rank-strided like the census; no-op for txt
-    # output or under LDDL_TPU_MANIFEST=0.
-    build_manifest(out_dir, comm=comm, log=log)
+    # output or under LDDL_TPU_MANIFEST=0. The ingest service passes
+    # emit_manifest=False: its work-dir part files are consumed by the
+    # delta balancer immediately, and the published directories get
+    # their manifests (with generation meta) from the ingest publisher.
+    if emit_manifest:
+        build_manifest(out_dir, comm=comm, log=log)
 
     if comm.rank == 0:
         if global_shuffle:
@@ -1017,6 +1029,7 @@ def run_bert_preprocess(
     lease_ttl=30.0,
     holder_id=None,
     scatter_units=None,
+    emit_manifest=True,
 ):
     """Run the full BERT preprocessing pipeline (see run_sharded_pipeline
     for the SPMD execution contract). ``num_workers`` > 1 fans the bucket
@@ -1055,4 +1068,5 @@ def run_bert_preprocess(
         lease_ttl=lease_ttl,
         holder_id=holder_id,
         scatter_units=scatter_units,
+        emit_manifest=emit_manifest,
     )

@@ -653,7 +653,9 @@ def _finalize(spec, holder, ttl, keeper, log, poll):
         keeper.add(lease)
         try:
             with obs.span("preprocess.finalize", holder=holder):
-                build_manifest(out_dir, comm=LocalCommunicator(), log=log)
+                if spec.get("emit_manifest", True):
+                    build_manifest(out_dir, comm=LocalCommunicator(),
+                                   log=log)
                 if not leases.verify(lease):
                     obs.inc("lease_fence_rejects_total")
                     log("finalize: lease stolen mid-manifest; yielding to "

@@ -16,7 +16,8 @@ Spec grammar — comma-separated clauses of colon-separated fields::
                [:path=<substr>][:delay=<float>][:flag=<file>]
 
     op    site name: open | read | replace | worker | lease-acquire |
-          lease-renew | lease-release (or * for any site)
+          lease-renew | lease-release | journal-read | journal-publish
+          (or * for any site)
     kind  eio | estale | truncate | slow | stall | kill
     p     per-call injection probability (seeded per process)
     nth   inject on exactly the Nth matching call of this process
@@ -35,6 +36,8 @@ Examples::
     LDDL_TPU_FAULTS="worker:kill:nth=2:flag=/tmp/k2"  # loader worker death
     LDDL_TPU_FAULTS="lease-renew:stall:nth=1:delay=20"  # freeze renewal,
                                                         # force a steal
+    LDDL_TPU_FAULTS="journal-read:truncate:nth=1"  # torn ingest-journal
+                                                   # cache -> segment rescan
 """
 
 import errno

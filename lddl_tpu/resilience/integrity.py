@@ -85,14 +85,20 @@ def read_manifest(dir_path):
     return manifest if isinstance(manifest, dict) else None
 
 
-def build_manifest(dir_path, comm=None, log=None):
+def build_manifest(dir_path, comm=None, log=None, extra_meta=None):
     """Checksum every parquet shard directly in ``dir_path`` (rank-strided)
     and publish the manifest from rank 0.
 
     ``LDDL_TPU_MANIFEST`` modes: ``full`` (default; stat sizes + one CRC32
     read pass over this rank's stride), ``size`` (stat only — zero extra
     reads, for multi-TB outputs where the CRC pass is too expensive; the
-    loader then verifies lengths only), ``0`` (skip entirely)."""
+    loader then verifies lengths only), ``0`` (skip entirely).
+
+    ``extra_meta`` merges additional keys into the reserved ``__meta__``
+    entry — the streaming-ingestion publisher records the latest
+    generation number and per-generation shard lists there (the loader's
+    generation-pickup gate). The caller must pass deterministic content
+    only; manifest bytes are resume-compared."""
     mode = os.environ.get("LDDL_TPU_MANIFEST", "full")
     if mode == "0":
         return None
@@ -102,10 +108,10 @@ def build_manifest(dir_path, comm=None, log=None):
     from ..parallel.distributed import LocalCommunicator
     comm = comm or LocalCommunicator()
     names = _parquet_basenames(dir_path)
-    if not names:
+    if not names and not extra_meta:
         return None
     with obs_span("resilience.build_manifest", mode=mode, shards=len(names)):
-        return _build_manifest(dir_path, comm, names, mode, log)
+        return _build_manifest(dir_path, comm, names, mode, log, extra_meta)
 
 
 def _shard_schema_version(path):
@@ -121,7 +127,7 @@ def _shard_schema_version(path):
         return None
 
 
-def _build_manifest(dir_path, comm, names, mode, log):
+def _build_manifest(dir_path, comm, names, mode, log, extra_meta=None):
     sizes = [0] * len(names)
     crcs = [0] * len(names)
     vflags = [0, 0]  # token-id schema v1 / v2 seen on this rank's stride
@@ -159,6 +165,8 @@ def _build_manifest(dir_path, comm, names, mode, log):
         manifest["__meta__"] = {"schema_version": versions[0]}
     elif versions:
         manifest["__meta__"] = {"schema_versions": versions}
+    if extra_meta:
+        manifest.setdefault("__meta__", {}).update(extra_meta)
     if comm.rank == 0:
         atomic_write(os.path.join(dir_path, MANIFEST_NAME),
                      json.dumps(manifest, sort_keys=True))

@@ -186,6 +186,49 @@ def atomic_write(path, data, retries=True):
     return _write()
 
 
+def atomic_copy(src, path, retries=True):
+    """Atomically publish an existing durable file at ``path`` without
+    loading it into memory.
+
+    Fast path: hard-link ``src`` to a temp name and ``os.replace`` it in
+    (zero data copy; ``src`` must already be durable — e.g. produced by
+    atomic_write/write_table_atomic, which fsync). Filesystems without
+    hard links fall back to a chunked copy + fsync. Either way ``src`` is
+    left in place, so a crashed publish re-runs idempotently. Same crash
+    contract as atomic_write: the target is never torn."""
+    tmp = "{}.tmp.{}".format(path, os.getpid())
+
+    def _copy():
+        faults.fault_point("open", path)
+        try:
+            try:
+                os.link(src, tmp)
+                atomic_publish(tmp, path, fsync_file=False)
+            except OSError:
+                # No hard links here (or a stale tmp): chunked fallback.
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                with open(src, "rb") as fin, open(tmp, "wb") as fout:
+                    while True:
+                        chunk = fin.read(1 << 20)
+                        if not chunk:
+                            break
+                        fout.write(chunk)
+                    fout.flush()
+                    os.fsync(fout.fileno())
+                atomic_publish(tmp, path, fsync_file=False)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    if retries:
+        return with_retries(_copy, desc="atomic_copy {}".format(path))
+    return _copy()
+
+
 def read_bytes(path, retries=True):
     """Read a whole file with transient-error retries and fault injection
     (``truncate`` faults chop the returned payload, simulating a torn

@@ -14,6 +14,7 @@ from 0. This module is the single owner of that protocol.
 import io
 import json
 import os
+import re
 
 import numpy as np
 import pyarrow.parquet as pq
@@ -25,6 +26,17 @@ from ..resilience.io import atomic_write, with_retries
 # consumed by the loader so startup does not need to touch every footer.
 # (ref: lddl/dask/load_balance.py:372-378, lddl/torch/datasets.py:166-187)
 NUM_SAMPLES_CACHE_NAME = ".num_samples.json"
+
+# Reserved key inside .num_samples.json holding {basename: byte_length}
+# for per-entry staleness checks on growing (multi-generation) shard
+# directories. Never a parquet basename (leading underscore-dunder), so
+# count consumers that iterate the cache skip it by path lookup.
+NUM_SAMPLES_SIZES_KEY = "__sizes__"
+
+# Streaming-ingestion generation subdirectories: the root directory holds
+# generation 0's shards; each incremental ingest publishes its tail into
+# gen-<NNNN>/ so prior generations' bytes are never rewritten.
+GENERATION_DIR_RE = re.compile(r"^gen-(\d{4,})$")
 
 
 def mkdir(d):
@@ -38,12 +50,19 @@ def expand_outdir_and_mkdir(outdir):
 
 
 def get_all_files_paths_under(root):
-    """All file paths (recursively) under ``root``, sorted for determinism."""
-    return sorted(
-        os.path.join(dirpath, f)
-        for dirpath, _, filenames in os.walk(root)
-        for f in filenames
-    )
+    """All file paths (recursively) under ``root``, sorted for determinism.
+
+    Hidden directories (any path component starting with ``.``) are
+    skipped: the streaming-ingestion service keeps its journal, staging
+    corpora, and in-flight preprocess scratch under ``<root>/.ingest/``,
+    and those part files must never be mistaken for published shards."""
+    out = []
+    # Walk order is unobservable: results accumulate into one list that
+    # is sorted before returning. -- lddl: disable=unsorted-iteration
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if not d.startswith(".")]
+        out.extend(os.path.join(dirpath, f) for f in filenames)
+    return sorted(out)
 
 
 def _is_parquet_path(path):
@@ -87,6 +106,25 @@ def get_all_bin_ids(file_paths):
 
 def get_file_paths_for_bin_id(file_paths, bin_id):
     return [p for p in file_paths if get_bin_id_of_path(p) == bin_id]
+
+
+def generation_dir_name(generation):
+    """Directory name of one ingest generation's shards under the dataset
+    root. Generation 0 is the root itself (classic balanced layout), so
+    only generations >= 1 get a subdirectory."""
+    if generation < 1:
+        raise ValueError(
+            "generation 0 lives in the dataset root, not a subdirectory")
+    return "gen-{:04d}".format(generation)
+
+
+def get_generation_of_path(root, path):
+    """Which ingest generation a shard path belongs to: N for paths under
+    ``<root>/gen-<NNNN>/``, 0 for shards directly in the root."""
+    rel = os.path.relpath(os.path.abspath(path), os.path.abspath(root))
+    head = rel.split(os.sep, 1)[0]
+    m = GENERATION_DIR_RE.match(head)
+    return int(m.group(1)) if m else 0
 
 
 def get_num_samples_of_parquet(path):
@@ -141,15 +179,69 @@ def num_samples_cache_is_stale(dir_path, cache):
     except OSError:
         return True
     on_disk = {n for n in names if _is_parquet_path(n)}
-    return set(cache) != on_disk
+    return {k for k in cache if k != NUM_SAMPLES_SIZES_KEY} != on_disk
 
 
-def write_num_samples_cache(dir_path, counts):
+def trusted_num_samples_entries(dir_path, cache):
+    """Split one directory's cache into (trusted {basename: count},
+    untrusted set-of-basenames-on-disk).
+
+    Legacy caches (no ``__sizes__`` map) keep the all-or-nothing contract:
+    a key-set mismatch distrusts the whole cache. Sized caches (written by
+    the ingest service) validate **per entry** — an entry is trusted iff
+    its recorded byte length matches the file on disk — so appending a
+    generation or flushing a tail invalidates only the shards that
+    actually changed instead of forcing a full directory re-count."""
+    try:
+        names = sorted(os.listdir(dir_path))
+    except OSError:
+        return {}, set()
+    on_disk = [n for n in names if _is_parquet_path(n)]
+    if cache is None:
+        return {}, set(on_disk)
+    sizes = cache.get(NUM_SAMPLES_SIZES_KEY)
+    if not isinstance(sizes, dict):
+        if num_samples_cache_is_stale(dir_path, cache):
+            return {}, set(on_disk)
+        return dict(cache), set()
+    trusted, untrusted = {}, set()
+    for name in on_disk:
+        entry_ok = False
+        if name in cache and name in sizes:
+            try:
+                entry_ok = os.path.getsize(
+                    os.path.join(dir_path, name)) == sizes[name]
+            except OSError:
+                entry_ok = False
+        if entry_ok:
+            trusted[name] = cache[name]
+        else:
+            untrusted.add(name)
+    return trusted, untrusted
+
+
+def write_num_samples_cache(dir_path, counts, with_sizes=False):
     """Store {basename: count} next to the shards. Durable AND atomic
     (resilience.io.atomic_write): the old tmp+rename path skipped fsync,
-    so a crash shortly after could durably publish an EMPTY cache file."""
+    so a crash shortly after could durably publish an EMPTY cache file.
+
+    ``with_sizes=True`` (the ingest service's mode) additionally records
+    each shard's byte length under the reserved ``__sizes__`` key so
+    growing directories can be validated per entry (see
+    ``trusted_num_samples_entries``)."""
     cache_path = os.path.join(dir_path, NUM_SAMPLES_CACHE_NAME)
-    atomic_write(cache_path, json.dumps(counts))
+    payload = dict(counts)
+    if with_sizes:
+        sizes = {}
+        for name in sorted(counts):
+            try:
+                sizes[name] = os.path.getsize(os.path.join(dir_path, name))
+            # A racing unlink just leaves the entry size-less: it then
+            # reads as untrusted and is recounted from its footer.
+            except OSError:  # lddl: disable=swallowed-error
+                pass
+        payload[NUM_SAMPLES_SIZES_KEY] = sizes
+    atomic_write(cache_path, json.dumps(payload, sort_keys=True))
 
 
 def serialize_np_array(a):

@@ -307,3 +307,96 @@ def test_elastic_forced_stall_fence_reject(fixture_dirs, reference_hashes,
     done = sum(_counter_total(m, "elastic_units_completed_total")
                for m in mdirs.values())
     assert done == 24, done
+
+
+# --------------------------------------------------- streaming ingestion
+
+# Driver for one ingest round (journal diff -> incremental preprocess ->
+# delta balance -> journal commit). argv: landing vocab root
+_INGEST_DRIVER = """
+import sys
+from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+from lddl_tpu.ingest import ingest_once
+
+landing, vocab, root = sys.argv[1:4]
+tok = get_tokenizer(vocab_file=vocab)
+cfg = BertPretrainConfig(max_seq_length=32, masking=False)
+print("REPORT", ingest_once(root, tok, landing=landing, config=cfg,
+                            num_shards=4, seed=7, log=print))
+"""
+
+
+def _run_ingest(landing, vocab, root, fault_spec=None, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    if fault_spec:
+        env["LDDL_TPU_FAULTS"] = fault_spec
+    else:
+        env.pop("LDDL_TPU_FAULTS", None)
+    return subprocess.run(
+        [sys.executable, "-c", _INGEST_DRIVER, landing, vocab, root],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _hash_tree(root):
+    """Every file under ``root`` (shards, manifests, caches, journal) —
+    the ingest end state has no timestamps, so full-tree bytes compare."""
+    import hashlib
+    out = {}
+    for dirpath, _, filenames in os.walk(root):
+        for name in filenames:
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as f:
+                out[os.path.relpath(path, root)] = hashlib.sha256(
+                    f.read()).hexdigest()
+    return out
+
+
+def _ingest_landing(base, corpus, n_files, name):
+    import shutil
+    d = os.path.join(base, name, "source")
+    os.makedirs(d, exist_ok=True)
+    for i in range(n_files):
+        shutil.copy(os.path.join(corpus, "source", "{}.txt".format(i)),
+                    os.path.join(d, "{}.txt".format(i)))
+    return os.path.join(base, name)
+
+
+def test_sigkill_during_ingest_generation_resumes_byte_identical(
+        fixture_dirs, tmp_path):
+    """SIGKILL the ingest service while it is publishing generation 1's
+    shards (after preprocess, after the balance plan marker, BEFORE the
+    journal commit). The journal must still read generation 0, and the
+    re-run must resume the in-flight generation from its intake record
+    and converge to a tree byte-identical — shards, manifests, caches,
+    AND journal — to an uninterrupted incremental sequence."""
+    td, corpus, vocab = fixture_dirs
+    base = str(tmp_path)
+    land2 = _ingest_landing(base, corpus, 2, "land2")
+    land3 = _ingest_landing(base, corpus, 3, "land3")
+
+    ref = str(tmp_path / "ref")
+    for landing in (land2, land3):
+        proc = _run_ingest(landing, vocab, ref)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    root = str(tmp_path / "root")
+    proc = _run_ingest(land2, vocab, root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    proc = _run_ingest(land3, vocab, root,
+                       fault_spec="replace:kill:nth=1:path=gen-0001/shard-")
+    assert proc.returncode == -9, proc.stdout + proc.stderr  # really killed
+    # Mid-generation: the delta's work was in flight but nothing committed
+    # — the journal still reads generation 0 and the intake record of the
+    # crashed generation is on disk.
+    assert not os.path.exists(
+        os.path.join(root, ".ingest", "journal", "gen-0001.json"))
+    assert os.path.exists(
+        os.path.join(root, ".ingest", "work", "gen-0001", "intake.json"))
+
+    proc = _run_ingest(land3, vocab, root)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "generation" in proc.stdout
+    assert _hash_tree(root) == _hash_tree(ref)

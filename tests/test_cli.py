@@ -14,6 +14,7 @@ ENTRY_POINTS = [
     ("lddl_tpu.cli.preprocess_bart_pretrain", "attach_args"),
     ("lddl_tpu.cli.balance_shards", "attach_args"),
     ("lddl_tpu.cli.generate_num_samples_cache", "attach_args"),
+    ("lddl_tpu.cli.ingest_watch", "attach_args"),
 ]
 
 
@@ -40,7 +41,7 @@ def test_pyproject_scripts_resolve():
     block = re.search(r"\[project\.scripts\]\n(.*?)\n\[", text,
                       re.S).group(1)
     entries = re.findall(r'^\S+ = "([\w\.]+):(\w+)"', block, re.M)
-    assert len(entries) == 8
+    assert len(entries) == 9
     for module, attr in entries:
         assert callable(getattr(importlib.import_module(module), attr))
 

@@ -805,3 +805,112 @@ def test_fixture_rules_scope_marking():
     by_id = {r.id: r for r in analysis.all_rules()}
     assert by_id["fs-order-flow"].scope == "project"
     assert by_id["unsorted-iteration"].scope == "file"
+
+
+# ----------------------- ingest journal/generation builders (PR 8)
+
+
+def test_wall_clock_flow_into_journal_builder_content(tmp_path):
+    """Journal segments are resume-compared, content-hash-only bytes —
+    a clock value laundered through an observability helper into a
+    journal builder's content must flag exactly like a manifest."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/observability/stamp.py": WALLCLOCK_HELPER,
+        "lddl_tpu/ingest/journal.py": """
+            from ..observability.stamp import now_tag
+
+            def build_journal_segment(hashes):
+                return {"stamp": now_tag(), "hashes": sorted(hashes)}
+        """,
+    })
+    [f] = flow_findings(report, "wall-clock-flow")
+    assert f.path == "lddl_tpu/ingest/journal.py"
+    assert "time.time" in f.message
+    # Direct-call rule has nothing to see (the clock is in the helper).
+    assert not any(f.rule == "manifest-determinism" for f in report.new)
+
+
+def test_manifest_determinism_covers_ingest_builder_names(tmp_path):
+    """The syntactic rule's name gate extends to the ingest record
+    builders: journal / intake / generation functions drawing
+    nondeterminism directly each flag."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/ingest/records.py": """
+            import os
+            import time
+            import uuid
+
+            def build_journal_record(hashes):
+                return {"at": time.time(), "hashes": sorted(hashes)}
+
+            def publish_intake_record(docs):
+                return {"pid": os.getpid(), "docs": sorted(docs)}
+
+            def generation_meta(n):
+                return {"id": str(uuid.uuid4()), "generation": n}
+        """,
+    }, rules=["manifest-determinism"])
+    found = [f for f in report.new if f.rule == "manifest-determinism"]
+    assert len(found) == 3
+
+
+def test_fs_order_flow_into_journal_record(tmp_path):
+    """Landing-scan order must never shape journal bytes: an unsorted
+    listing crossing into an intake builder and iterated there flags."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/utils/listing.py": FS_HELPER,
+        "lddl_tpu/ingest/scan.py": """
+            from ..utils.listing import entries
+
+            def build_intake_hashes(d):
+                out = []
+                for name in entries(d):
+                    out.append(name)
+                return out
+        """,
+    })
+    [f] = flow_findings(report, "fs-order-flow")
+    assert f.path == "lddl_tpu/ingest/scan.py"
+
+
+def test_journal_builder_content_hash_only_is_clean(tmp_path):
+    """The sanctioned shape: content hashes + sorted iteration + a
+    deterministic generation counter — silent under BOTH rule families."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/ingest/journal.py": """
+            import hashlib
+            import os
+
+            def doc_hash(text):
+                return hashlib.blake2b(text, digest_size=16).hexdigest()
+
+            def build_journal_segment(generation, texts):
+                hashes = sorted(doc_hash(t) for t in texts)
+                return {"generation": generation, "hashes": hashes}
+
+            def scan_landing(d):
+                return sorted(os.listdir(d))
+        """,
+    })
+    assert report.new == []
+
+
+def test_publish_path_flow_covers_ingest_package(tmp_path):
+    """lddl_tpu/ingest/ is a shard package: a raw write laundered
+    through an outside helper flags exactly as it would from
+    preprocess/."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/utils/textio.py": """
+            def write_text(path, text):
+                with open(path, "w") as f:
+                    f.write(text)
+        """,
+        "lddl_tpu/ingest/sink.py": """
+            from ..utils.textio import write_text
+
+            def dump_segment(out_dir, payload):
+                write_text(out_dir + "/gen-0001.json", payload)
+        """,
+    }, rules=["publish-path-flow"])
+    [f] = flow_findings(report, "publish-path-flow")
+    assert f.path == "lddl_tpu/ingest/sink.py"
