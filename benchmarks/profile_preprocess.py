@@ -35,11 +35,15 @@ import bench  # noqa: E402  (repo-root bench.py: corpus + vocab helpers)
 
 
 _SINKS = (
+    # Order matters: first match wins (mask_batch lives in native/__init__
+    # but is masking work, so it must match before the tokenize needle).
+    ("masking", ("ops/masking", "mask_batch")),
     ("tokenize_native", ("native/__init__", "ctypes")),
-    ("masking", ("ops/masking",)),
+    ("durable_publish_io", ("posix.fsync", "zlib.crc32", "resilience/io",
+                            "resilience/integrity")),
     ("arrow_write", ("arrowcols", "binning", "pyarrow", "parquet")),
-    ("spool_io", ("_read_group", "_scatter", "_write_txt", "spool",
-                  "readers")),
+    ("spool_io", ("_read_group", "_scatter", "_scan_block", "_spool_one",
+                  "_write_txt", "spool", "readers")),
     ("pairs_instances", ("preprocess/bert", "pairs_from", "instances_from")),
 )
 
@@ -110,6 +114,24 @@ def main():
         st.sort_stats("tottime").print_stats(30)
         print(buf.getvalue())
 
+        # Before/after: carry the prior artifact's headline + sink
+        # breakdown forward so a perf PR's attribution shift is readable
+        # from the committed artifact alone.
+        previous = None
+        if os.path.exists(ns.out):
+            try:
+                with open(ns.out) as f:
+                    prior = json.load(f)
+                previous = {
+                    "mb_per_s_single_worker":
+                        prior.get("mb_per_s_single_worker"),
+                    "elapsed_s": prior.get("elapsed_s"),
+                    "host_calibration_s": prior.get("host_calibration_s"),
+                    "sinks_tottime_s": prior.get("sinks_tottime_s"),
+                }
+            except (ValueError, OSError):
+                previous = None
+
         # Aggregate tottime into named sinks + top functions, and write
         # the committed artifact.
         sinks = {}
@@ -142,6 +164,8 @@ def main():
                     "shares, not absolute seconds, and compare MB/s only "
                     "against other single-worker profiled runs.",
         }
+        if previous is not None:
+            payload["previous"] = previous
         with open(ns.out, "w") as f:
             json.dump(payload, f, indent=1)
         print("wrote", ns.out)

@@ -2,18 +2,28 @@
 
 Public surface:
     available() -> bool
+    fused_enabled() -> bool     (the one-pass instances kernel; env-gated)
     NativeTokenizer(id_to_token, unk_id, do_lower_case)
         .tokenize_docs(texts) -> (ids, sent_lens, doc_sent_counts) np arrays
+        .bert_instances(docs, ...) -> packed instance arrays in ONE pass
+    mask_batch(key, ids, candidate, ...) -> numpy-Philox-replay masking
     split_docs(texts) -> list[list[str]]   (sentence split only; BART path)
 
 The engine replaces the reference's per-partition sentence-split + HF
 tokenize hot loop (lddl/dask/bert/pretrain.py:77-97) with one native pass;
-semantics parity with the Python/HF path is enforced by tests/test_native.py.
+semantics parity with the Python/HF path is enforced by tests/test_native.py
+and tests/test_fused.py.
+
+Zero-copy result contract: the kernels malloc exactly-sized output buffers
+and transfer ownership — the binding wraps each buffer as a numpy array
+whose finalizer (weakref.finalize -> lddl_buf_free) frees it when the last
+view dies. No ``.copy()`` ever happens at the boundary.
 """
 
 import ctypes
 import os
 import threading
+import weakref
 
 import numpy as np
 
@@ -54,6 +64,21 @@ class _SplitResult(ctypes.Structure):
     ]
 
 
+class _InstResult(ctypes.Structure):
+    _fields_ = [
+        ("seq_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_seq_ids", ctypes.c_int64),
+        ("seq_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("a_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("is_random_next", ctypes.POINTER(ctypes.c_uint8)),
+        ("n_instances", ctypes.c_int64),
+        ("a_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_a_ids", ctypes.c_int64),
+        ("b_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_b_ids", ctypes.c_int64),
+    ]
+
+
 def _load():
     global _lib, _lib_tried
     with _lock:
@@ -73,7 +98,7 @@ def _load():
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.
         try:
-            if lib.lddl_native_abi_version() != 5:
+            if lib.lddl_native_abi_version() != 6:
                 return None
         except AttributeError:
             return None
@@ -114,12 +139,82 @@ def _load():
             ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
             ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
         lib.lddl_pairs_free.argtypes = [ctypes.POINTER(_PairResult)]
+        lib.lddl_pairs_release.argtypes = [ctypes.POINTER(_PairResult)]
+        lib.lddl_tok_result_release.argtypes = [ctypes.POINTER(_TokResult)]
+        lib.lddl_buf_free.argtypes = [ctypes.c_void_p]
+        lib.lddl_bert_instances.restype = ctypes.POINTER(_InstResult)
+        lib.lddl_bert_instances.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32]
+        lib.lddl_inst_free.argtypes = [ctypes.POINTER(_InstResult)]
+        lib.lddl_inst_release.argtypes = [ctypes.POINTER(_InstResult)]
+        lib.lddl_mask_batch.restype = None
+        lib.lddl_mask_batch.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8)]
+        lib.lddl_split_docs_spans.restype = ctypes.POINTER(_SplitResult)
+        lib.lddl_split_docs_spans.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_int64]
         _lib = lib
         return _lib
 
 
 def available():
     return _load() is not None
+
+
+def fused_enabled():
+    """True when the one-pass fused instances kernel should be used.
+    ``LDDL_TPU_NATIVE_FUSED=0`` drops to the staged native engine
+    (tokenize + pairs as two calls) — the first rung of the runtime
+    fallback ladder fused -> staged -> hf. Read per call so tests (and
+    spawned pool workers, which inherit the environment) can flip it."""
+    return (_load() is not None
+            and os.environ.get("LDDL_TPU_NATIVE_FUSED", "1") != "0")
+
+
+def _owned_array(lib, ptr, n, ctype, dtype):
+    """Wrap a malloc'd kernel buffer as a numpy array WITHOUT copying;
+    ownership transfers to the array — a finalizer frees the buffer when
+    the array (and every view holding a base reference to it) is gone.
+
+    Exception-safety contract with the result structs: the caller nulls
+    the struct field right after this returns and always calls the
+    kernel's ``*_free`` in a ``finally`` — so a failure mid-wrap frees
+    exactly the not-yet-transferred buffers (free(NULL) is a no-op for
+    the transferred ones) and the struct itself, never double-freeing."""
+    addr = ctypes.cast(ptr, ctypes.c_void_p).value
+    if not n or not addr:
+        if addr:
+            lib.lddl_buf_free(addr)
+        return np.zeros(0, dtype=dtype)
+    arr = np.ctypeslib.as_array(ctypes.cast(addr, ctypes.POINTER(ctype)),
+                                shape=(int(n),))
+    weakref.finalize(arr, lib.lddl_buf_free, addr)
+    return arr
+
+
+def _doc_ranges(docs):
+    """(buf, starts, ends, n, keepalive) for the native kernels.
+
+    ``docs`` is either a zero-copy span view (readers.DocSpans duck type:
+    ``.buffer``/``.starts``/``.ends``) — no bytes are touched — or any
+    sequence of bytes/str, which packs into one contiguous buffer."""
+    buffer = getattr(docs, "buffer", None)
+    if buffer is not None:
+        starts = np.ascontiguousarray(docs.starts, dtype=np.int64)
+        ends = np.ascontiguousarray(docs.ends, dtype=np.int64)
+        return buffer, starts, ends, len(starts), (starts, ends)
+    buf, offsets = _pack_docs(docs)
+    return buf, offsets[:-1], offsets[1:], len(docs), (offsets,)
 
 
 def join_tokens(flat_ids, row_lens, blob, tok_starts, tok_lens,
@@ -210,26 +305,90 @@ class NativeTokenizer:
 
         Sentences are concatenated in document order; empty sentences are
         dropped; doc_sent_counts[d] = number of non-empty sentences of
-        document d.
+        document d. The returned arrays wrap the kernel's buffers without
+        copying (ownership transfers; a finalizer frees each buffer).
         """
-        if not texts:
+        if not len(texts):
             z = np.zeros(0, dtype=np.int32)
             return z, z.copy(), z.copy()
+        lib = self._lib
         buf, offsets = _pack_docs(texts)
-        res = self._lib.lddl_tok_docs(
+        res = lib.lddl_tok_docs(
             self._handle, buf,
             offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             len(texts))
         try:
             r = res.contents
-            ids = np.ctypeslib.as_array(r.ids, shape=(r.n_ids,)).copy()
-            sent_lens = np.ctypeslib.as_array(
-                r.sent_lens, shape=(r.n_sents,)).copy()
-            doc_counts = np.ctypeslib.as_array(
-                r.doc_sent_counts, shape=(r.n_docs,)).copy()
+            ids = _owned_array(lib, r.ids, r.n_ids, ctypes.c_int32,
+                               np.int32)
+            r.ids = None
+            sent_lens = _owned_array(lib, r.sent_lens, r.n_sents,
+                                     ctypes.c_int32, np.int32)
+            r.sent_lens = None
+            doc_counts = _owned_array(lib, r.doc_sent_counts, r.n_docs,
+                                      ctypes.c_int32, np.int32)
+            r.doc_sent_counts = None
         finally:
-            self._lib.lddl_tok_result_free(res)
+            # Frees whatever was NOT transferred (nulled fields are
+            # free(NULL) no-ops) plus the struct — leak-free even when a
+            # wrap raises mid-way.
+            lib.lddl_tok_result_free(res)
         return ids, sent_lens, doc_counts
+
+    def bert_instances(self, docs, max_seq_length, short_seq_prob,
+                       duplicate_factor, seed, bucket, cls_id, sep_id,
+                       want_ab=False):
+        """FUSED hot path: documents -> packed NSP instance arrays in one
+        native pass (split + normalize + WordPiece + pair creation +
+        in-bucket shuffle), bit-identical to tokenize_docs + bert_pairs.
+
+        ``docs`` is a readers.DocSpans view (zero-copy: the kernel reads
+        the spool buffer in place) or a sequence of bytes/str. Returns
+        (seq_ids, seq_lens, a_lens, is_random_next, a_ids, b_ids) numpy
+        arrays wrapping the kernel's buffers without copying; a_ids/b_ids
+        are None unless ``want_ab``.
+        """
+        lib = self._lib
+        if not len(docs):
+            z = np.zeros(0, dtype=np.int32)
+            empty_ab = z.copy() if want_ab else None
+            return (z, z.copy(), z.copy(), np.zeros(0, dtype=bool),
+                    empty_ab, z.copy() if want_ab else None)
+        buf, starts, ends, n, _keep = _doc_ranges(docs)
+        p_i64 = ctypes.POINTER(ctypes.c_int64)
+        res = lib.lddl_bert_instances(
+            self._handle, buf,
+            starts.ctypes.data_as(p_i64), ends.ctypes.data_as(p_i64),
+            n, int(max_seq_length), float(short_seq_prob),
+            int(duplicate_factor), int(seed) & (2**64 - 1),
+            int(bucket) & (2**64 - 1), int(cls_id), int(sep_id),
+            1 if want_ab else 0)
+        try:
+            r = res.contents
+            n_inst = r.n_instances
+            seq_ids = _owned_array(lib, r.seq_ids, r.n_seq_ids,
+                                   ctypes.c_int32, np.int32)
+            r.seq_ids = None
+            seq_lens = _owned_array(lib, r.seq_lens, n_inst,
+                                    ctypes.c_int32, np.int32)
+            r.seq_lens = None
+            a_lens = _owned_array(lib, r.a_lens, n_inst,
+                                  ctypes.c_int32, np.int32)
+            r.a_lens = None
+            rn = _owned_array(lib, r.is_random_next, n_inst,
+                              ctypes.c_uint8, np.uint8).view(np.bool_)
+            r.is_random_next = None
+            a_ids = b_ids = None
+            if want_ab:
+                a_ids = _owned_array(lib, r.a_ids, r.n_a_ids,
+                                     ctypes.c_int32, np.int32)
+                r.a_ids = None
+                b_ids = _owned_array(lib, r.b_ids, r.n_b_ids,
+                                     ctypes.c_int32, np.int32)
+                r.b_ids = None
+        finally:
+            lib.lddl_inst_free(res)  # see tokenize_docs: leak-free
+        return seq_ids, seq_lens, a_lens, rn, a_ids, b_ids
 
 
 def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
@@ -255,17 +414,52 @@ def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
     try:
         r = res.contents
         n = r.n_instances
-        if n == 0:
-            z32 = np.zeros(0, dtype=np.int32)
-            return (z32, z32.copy(), z32.copy(), np.zeros(0, dtype=bool))
-        seq_ids = np.ctypeslib.as_array(r.seq_ids, shape=(r.n_seq_ids,)).copy()
-        seq_lens_o = np.ctypeslib.as_array(r.seq_lens, shape=(n,)).copy()
-        a_lens = np.ctypeslib.as_array(r.a_lens, shape=(n,)).copy()
-        rn = np.ctypeslib.as_array(r.is_random_next,
-                                   shape=(n,)).astype(bool)
+        seq_ids = _owned_array(lib, r.seq_ids, r.n_seq_ids,
+                               ctypes.c_int32, np.int32)
+        r.seq_ids = None
+        seq_lens_o = _owned_array(lib, r.seq_lens, n, ctypes.c_int32,
+                                  np.int32)
+        r.seq_lens = None
+        a_lens = _owned_array(lib, r.a_lens, n, ctypes.c_int32, np.int32)
+        r.a_lens = None
+        rn = _owned_array(lib, r.is_random_next, n,
+                          ctypes.c_uint8, np.uint8).view(np.bool_)
+        r.is_random_next = None
     finally:
-        lib.lddl_pairs_free(res)
+        lib.lddl_pairs_free(res)  # see tokenize_docs: leak-free
     return seq_ids, seq_lens_o, a_lens, rn
+
+
+def mask_batch(key_bytes, ids, candidate, num_to_predict, mask_id,
+               vocab_size):
+    """Static MLM masking — a bit-exact native replay of
+    ops.masking.mask_batch_numpy on the numpy-Philox stream keyed by
+    ``key_bytes`` (utils.rng.sample_key_bytes). Returns (masked_ids,
+    selected) or None when the native engine is unavailable, disabled
+    (``LDDL_TPU_NATIVE_MASK=0``), or the parameters fall outside the
+    frozen replay contract (vocab size must be in [2, 2^32))."""
+    lib = _load()
+    if lib is None or os.environ.get("LDDL_TPU_NATIVE_MASK") == "0":
+        return None
+    vocab_size = int(vocab_size)
+    if not (2 <= vocab_size < 0xFFFFFFFF):
+        return None
+    ids = np.ascontiguousarray(ids, dtype=np.int32)
+    candidate = np.ascontiguousarray(candidate, dtype=np.uint8)
+    num_to_predict = np.ascontiguousarray(num_to_predict, dtype=np.int64)
+    n, width = ids.shape
+    out = np.empty_like(ids)
+    selected = np.empty((n, width), dtype=np.uint8)
+    k0, k1 = np.frombuffer(key_bytes, dtype="<u8")
+    p_i32 = ctypes.POINTER(ctypes.c_int32)
+    p_u8 = ctypes.POINTER(ctypes.c_uint8)
+    lib.lddl_mask_batch(
+        int(k0), int(k1),
+        ids.ctypes.data_as(p_i32), candidate.ctypes.data_as(p_u8),
+        num_to_predict.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, width, int(mask_id), vocab_size,
+        out.ctypes.data_as(p_i32), selected.ctypes.data_as(p_u8))
+    return out, selected.view(np.bool_)
 
 
 def split_docs(texts, splitter_blob=None):
@@ -274,31 +468,37 @@ def split_docs(texts, splitter_blob=None):
     Same boundaries as preprocess.sentences.split_sentences — or, with
     ``splitter_blob`` (SplitterParams.serialize()), as
     split_sentences_learned (enforced by tests); raises RuntimeError when
-    the native engine is unavailable.
+    the native engine is unavailable. ``texts`` may be a readers.DocSpans
+    view (zero-copy: the kernel scans the spool buffer in place) or any
+    sequence of str/bytes.
     """
     lib = _load()
     if lib is None:
         raise RuntimeError("native engine unavailable")
-    if not texts:
+    if not len(texts):
         return []
-    buf, offsets = _pack_docs(texts)
-    res = lib.lddl_split_docs2(
-        buf, offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        len(texts), splitter_blob, len(splitter_blob or b""))
+    buf, starts, ends, n, _keep = _doc_ranges(texts)
+    p_i64 = ctypes.POINTER(ctypes.c_int64)
+    res = lib.lddl_split_docs_spans(
+        buf, starts.ctypes.data_as(p_i64), ends.ctypes.data_as(p_i64),
+        n, splitter_blob, len(splitter_blob or b""))
     try:
         r = res.contents
-        starts = np.ctypeslib.as_array(r.starts, shape=(r.n_sents,)).copy()
-        ends = np.ctypeslib.as_array(r.ends, shape=(r.n_sents,)).copy()
+        starts_o = np.ctypeslib.as_array(r.starts, shape=(r.n_sents,)).copy()
+        ends_o = np.ctypeslib.as_array(r.ends, shape=(r.n_sents,)).copy()
         counts = np.ctypeslib.as_array(
             r.doc_sent_counts, shape=(r.n_docs,)).copy()
     finally:
         lib.lddl_split_result_free(res)
     out = []
     k = 0
-    for d in range(len(texts)):
+    # errors="replace" mirrors the Python path's document decode; sentence
+    # ranges of valid UTF-8 round-trip identically either way.
+    for d in range(n):
         sents = []
         for _ in range(int(counts[d])):
-            sents.append(buf[starts[k]:ends[k]].decode("utf-8"))
+            sents.append(bytes(buf[starts_o[k]:ends_o[k]])
+                         .decode("utf-8", errors="replace"))
             k += 1
         out.append(sents)
     return out

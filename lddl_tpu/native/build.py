@@ -26,15 +26,35 @@ def _march():
     return os.environ.get("LDDL_TPU_NATIVE_MARCH", "native")
 
 
+def source_digest():
+    """Digest of the kernel sources the .so must have been built from
+    (lddl_native.cpp + unicode_tables.h). Part of the meta tag so a stale
+    binary — mtime-equal but content-different sources, e.g. a git
+    checkout that preserves timestamps, or a partially synced tree —
+    fails the staleness check LOUDLY and rebuilds instead of silently
+    serving old kernels (tests/test_fused.py pins this)."""
+    import hashlib
+    h = hashlib.sha256()
+    for path in (SRC, TABLES):
+        try:
+            with open(path, "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + path.encode())
+    return h.hexdigest()[:16]
+
+
 def _lib_meta_tag():
     """Identifies what the cached .so was built FOR. -march=native bakes
     the build host's ISA into a .so cached in the package directory; on a
     shared tree (NFS, prebuilt image) a different host must rebuild
     instead of SIGILL-ing, so the march setting joins the staleness
     check. 'native' is intentionally not resolved to a concrete ISA: two
-    heterogeneous hosts sharing a tree should pin LDDL_TPU_NATIVE_MARCH."""
+    heterogeneous hosts sharing a tree should pin LDDL_TPU_NATIVE_MARCH.
+    The tag also embeds a digest of the kernel sources (source_digest),
+    so content drift rebuilds even when mtimes lie."""
     import platform
-    tag = "march=" + _march()
+    tag = "march=" + _march() + ";src=" + source_digest()
     if _march() == "native":
         tag += ";host=" + platform.machine()
         # A concrete per-microarch signal where available (x86 flags set
@@ -162,5 +182,7 @@ def ensure_built(verbose=False):
 
 
 if __name__ == "__main__":
+    import sys
     path = ensure_built(verbose=True)
     print(path or "BUILD FAILED")
+    sys.exit(0 if path else 1)

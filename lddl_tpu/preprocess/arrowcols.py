@@ -38,8 +38,8 @@ def joined_token_strings(flat_ids, row_lens, table):
 
     ``table`` is TokenizerInfo.token_byte_table(). Fast path: the native
     C memcpy join fills the Arrow data+offsets buffers in one pass.
-    Fallback: ONE C-level ``b"".join`` over the per-id plain/space-
-    prefixed bytes table. Either way no per-row Python string exists.
+    Fallback: a pure-numpy byte gather over the vocab blob (no per-token
+    Python at all). Either way no per-row Python string exists.
     """
     flat_ids = np.asarray(flat_ids, dtype=np.int64)
     row_lens = np.asarray(row_lens, dtype=np.int64)
@@ -73,11 +73,18 @@ def joined_token_strings(flat_ids, row_lens, table):
     row_bytes = cum[row_tok_starts + row_lens] - cum[row_tok_starts]
     offsets = _offsets32(row_bytes)
 
-    # Deliberate fast path: ONE C-level tolist per batch so the bytes
-    # join below runs as C map(__getitem__) — measured faster than any
-    # numpy gather over object arrays (VERDICT.md round 3).
-    sel = ((flat_ids << 1) | has_space).tolist()  # lddl: disable=python-hot-loop
-    data = b"".join(map(table.spaced.__getitem__, sel))
+    # Vectorized byte gather: copy every token's bytes from the vocab
+    # blob straight into the Arrow data buffer via one fancy index, with
+    # the inter-token spaces scattered first. (Replaces the old
+    # tolist + b"".join-over-spaced-table path — the per-token list round
+    # trip was this builder's last Python hot loop.)
+    blob_arr = np.frombuffer(table.blob, dtype=np.uint8)
+    data = np.empty(total, dtype=np.uint8)
+    tok_dst = cum[:-1] + has_space  # first payload byte of each token
+    data[cum[:-1][has_space == 1]] = 0x20  # the space precedes the token
+    src = np.repeat(table.starts[flat_ids], tl) + concat_aranges(tl)
+    dst = np.repeat(tok_dst, tl) + concat_aranges(tl)
+    data[dst] = blob_arr[src]
     return pa.Array.from_buffers(
         pa.utf8(), n, [None, pa.py_buffer(offsets), pa.py_buffer(data)])
 

@@ -40,9 +40,11 @@ class BartPretrainConfig:
             raise ValueError("splitter must be rules|learned")
 
 
-def chunks_from_text(text, config, g, splitter_params=None):
-    """One document -> list of chunk strings (leading-space joined, like
-    the reference's ``chunk += " " + sentence``)."""
+def chunks_from_sentences(sentences, config, g):
+    """One document's sentences -> list of chunk strings (leading-space
+    joined, like the reference's ``chunk += " " + sentence``). The draw
+    sequence depends only on chunk completions, so any splitter engine
+    producing the same sentences yields byte-identical chunks."""
     base_target = config.target_seq_length - 3
     chunks = []
     chunk = ""
@@ -50,8 +52,6 @@ def chunks_from_text(text, config, g, splitter_params=None):
     target = base_target
     if config.short_seq_prob > 0 and g.random() < config.short_seq_prob:
         target = int(g.integers(2, base_target + 1))
-    sentences = (split_sentences_learned(text, splitter_params)
-                 if splitter_params is not None else split_sentences(text))
     for sentence in sentences:
         chunk += " " + sentence
         num_tokens += len(sentence.split())
@@ -66,6 +66,13 @@ def chunks_from_text(text, config, g, splitter_params=None):
     if num_tokens > 0:
         chunks.append(chunk)
     return chunks
+
+
+def chunks_from_text(text, config, g, splitter_params=None):
+    """One document -> list of chunk strings (Python splitter path)."""
+    sentences = (split_sentences_learned(text, splitter_params)
+                 if splitter_params is not None else split_sentences(text))
+    return chunks_from_sentences(sentences, config, g)
 
 
 class BartBucketProcessor:
@@ -128,19 +135,41 @@ class BartBucketProcessor:
                 int32_list_array(sent_lens,
                                  [len(sents) for sents in per_chunk]))
 
+    def _native_sentences(self, texts):
+        """Whole-bucket native sentence split, or None to use the Python
+        splitter. Zero-copy when ``texts`` is a readers.DocSpans spool
+        view; boundaries are identical to the Python splitters (pinned by
+        tests/test_native.py + test_fused.py), so chunk bytes cannot
+        depend on the engine. ``LDDL_TPU_BART_NATIVE_SPLIT=0`` forces the
+        Python path."""
+        import os
+        if os.environ.get("LDDL_TPU_BART_NATIVE_SPLIT") == "0":
+            return None
+        from .. import native
+        if not native.available():
+            return None
+        blob = (self.splitter_params.serialize()
+                if self.splitter_params is not None else None)
+        return native.split_docs(texts, splitter_blob=blob)
+
     def __call__(self, texts, bucket):
         g = lrng.sample_rng(self.seed, 0xBA27, bucket)
         lrng.shuffle(g, texts)
         rows = []
-        for text in texts:
-            # The runner hands raw document BYTES (zero-decode spool
-            # path); BART chunking is str-based, so decode per document
-            # here — after the shuffle, which is order-only.
-            if isinstance(text, bytes):
-                text = text.decode("utf-8", errors="replace")
-            rows.extend(chunks_from_text(
-                text, self.config, g,
-                splitter_params=self.splitter_params))
+        per_doc_sentences = self._native_sentences(texts)
+        if per_doc_sentences is not None:
+            for sentences in per_doc_sentences:
+                rows.extend(chunks_from_sentences(sentences, self.config, g))
+        else:
+            for text in texts:
+                # The runner hands raw document BYTES (zero-decode spool
+                # path); BART chunking is str-based, so decode per
+                # document here — after the shuffle, which is order-only.
+                if isinstance(text, bytes):
+                    text = text.decode("utf-8", errors="replace")
+                rows.extend(chunks_from_text(
+                    text, self.config, g,
+                    splitter_params=self.splitter_params))
         os.makedirs(self.out_dir, exist_ok=True)
         if self.output_format == "txt":
             path = os.path.join(self.out_dir, "{}.txt".format(bucket))

@@ -96,25 +96,14 @@ class BertPretrainConfig:
 
 
 class _TokenByteTable:
-    """Vocab byte tables; ``spaced`` (the Python fallback's 2x-vocab join
-    table) is built lazily — unreachable when the native join is up."""
+    """Vocab byte tables: ``blob`` = all token UTF-8 bytes concatenated,
+    ``starts``/``lens`` per id — consumed by the native memcpy join and by
+    the numpy byte-gather fallback alike."""
 
     def __init__(self, enc, starts, lens):
-        self._enc = enc
         self.blob = b"".join(enc)
         self.starts = starts
         self.lens = lens
-        self._spaced = None
-
-    @property
-    def spaced(self):
-        if self._spaced is None:
-            spaced = []
-            for b in self._enc:
-                spaced.append(b)
-                spaced.append(b" " + b)
-            self._spaced = spaced
-        return self._spaced
 
 
 class TokenizerInfo:
@@ -192,10 +181,8 @@ class TokenizerInfo:
     def token_byte_table(self):
         """Vocab byte tables for the Arrow column builders
         (preprocess.arrowcols): ``blob`` = all token UTF-8 bytes
-        concatenated with per-id ``starts``/``lens`` (the native join
-        kernel's gather tables), and ``spaced`` = per-id bytes, plain at
-        2*id / space-prefixed at 2*id+1 (the Python fallback's join
-        table)."""
+        concatenated with per-id ``starts``/``lens`` — the gather tables
+        of the native memcpy join AND the numpy byte-gather fallback."""
         if self._token_bytes is None:
             enc = [t.encode("utf-8") for t in self.token_list]
             lens = np.fromiter(map(len, enc), dtype=np.int64, count=len(enc))
@@ -268,7 +255,10 @@ def _apply_splitter_params(nat, splitter_params):
 
 def documents_from_texts(texts, tokenizer, engine="auto",
                          splitter_params=None):
-    """Raw document texts -> documents as lists of per-sentence id lists.
+    """Raw document texts -> documents as lists of per-sentence id
+    sequences (Python lists on the hf engine, zero-copy int32 numpy views
+    on the native engine — both iterate/slice identically for the pair
+    engine).
 
     engine "native": one C++ pass (sentence split + normalize + memoized
     WordPiece, lddl_tpu.native) over the whole block. engine "hf": Python
@@ -362,6 +352,21 @@ def instances_from_texts(texts, tok_info, config, seed, bucket,
     if nat is not None:
         from .. import native
         _apply_splitter_params(nat, splitter_params)
+        if native.fused_enabled():
+            # FUSED rung: raw document bytes (zero-copy when ``texts`` is
+            # a readers.DocSpans spool view) -> packed instance buffers in
+            # ONE native pass; the kernel also hands back the flat A/B id
+            # segments on the unmasked path so the schema-v2 column
+            # builders wrap them without re-gathering.
+            seq_ids, seq_lens, a_lens, rn, a_ids, b_ids = \
+                nat.bert_instances(
+                    texts, config.max_seq_length, config.short_seq_prob,
+                    config.duplicate_factor, seed, bucket, tok_info.cls_id,
+                    tok_info.sep_id, want_ab=not config.masking)
+            return InstanceBatch(seq_ids, seq_lens, a_lens, rn,
+                                 a_ids=a_ids, b_ids=b_ids)
+        # STAGED rung (LDDL_TPU_NATIVE_FUSED=0): two native calls with
+        # ownership-transferred (still copy-free) result buffers.
         ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
         seq_ids, seq_lens, a_lens, rn = native.bert_pairs(
             ids, sent_lens, doc_counts, config.max_seq_length,
@@ -377,21 +382,16 @@ def instances_from_texts(texts, tok_info, config, seed, bucket,
 
 def _documents_from_texts_native(texts, nat):
     ids, sent_lens, doc_counts = nat.tokenize_docs(texts)
-    # ONE C-level tolist per gather batch; the per-sentence views below
-    # are C-level list slices, and downstream pair assembly concatenates
-    # sentences with list + (numpy slices would change those semantics).
-    flat = ids.tolist()  # lddl: disable=python-hot-loop
-    ends = np.cumsum(sent_lens)
+    # One vectorized split: per-sentence documents are zero-copy int32
+    # views of the flat id buffer (no per-token Python objects). The
+    # Python pair engine consumes them through iteration/len/slicing,
+    # which numpy arrays serve exactly like lists.
+    splits = np.split(ids, np.cumsum(sent_lens)[:-1])
     documents = []
     k = 0
-    pos = 0
     for d in range(len(texts)):
-        doc = []
-        for _ in range(int(doc_counts[d])):
-            end = int(ends[k])
-            doc.append(flat[pos:end])
-            pos = end
-            k += 1
+        doc = splits[k:k + int(doc_counts[d])]
+        k += int(doc_counts[d])
         if doc:
             documents.append(doc)
     return documents
@@ -524,12 +524,19 @@ class InstanceBatch:
     """One bucket's pretraining instances in flat array form — the native
     engine's output format; the Python engine converts into it. Row i is
     ``seq_ids[off_i : off_i + seq_lens[i]]`` = [CLS] a [SEP] b [SEP] with
-    ``a_lens[i]`` = len(a)."""
+    ``a_lens[i]`` = len(a).
+
+    ``a_ids``/``b_ids`` (optional): the flat A/B segments row-major — the
+    fused kernel emits them directly on the unmasked path so the column
+    builders skip the fancy-index re-gather; None means "derive from
+    seq_ids"."""
 
     seq_ids: np.ndarray        # int32, all rows concatenated
     seq_lens: np.ndarray       # int32 [n]
     a_lens: np.ndarray         # int32 [n]
     is_random_next: np.ndarray  # bool [n]
+    a_ids: np.ndarray = None   # int32, flat A segments (optional)
+    b_ids: np.ndarray = None   # int32, flat B segments (optional)
 
     def __len__(self):
         return len(self.seq_lens)
@@ -606,9 +613,21 @@ def apply_static_masking(batch, config, tok_info, seed, scope):
             ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),
             tok_info.mask_id, tok_info.vocab_size, tok_info.is_subword)
     else:
-        masked, selected = mask_batch_numpy(
-            ids, candidate, num_to_predict, lrng.sample_rng(seed, *scope),
-            tok_info.mask_id, tok_info.vocab_size)
+        # Native first: a bit-exact C++ replay of mask_batch_numpy on the
+        # SAME Philox stream (utils.rng.sample_key_bytes hands the kernel
+        # the stream key) — an implementation swap, not an engine fork,
+        # so shard bytes cannot depend on which one ran (pinned by
+        # tests/test_fused.py::test_native_mask_matches_numpy).
+        from .. import native
+        masked_selected = native.mask_batch(
+            lrng.sample_key_bytes(seed, *scope), ids, candidate,
+            num_to_predict, tok_info.mask_id, tok_info.vocab_size)
+        if masked_selected is None:
+            masked_selected = mask_batch_numpy(
+                ids, candidate, num_to_predict,
+                lrng.sample_rng(seed, *scope), tok_info.mask_id,
+                tok_info.vocab_size)
+        masked, selected = masked_selected
 
     return masked, selected, ids, a_lens, seq_lens
 
@@ -702,13 +721,18 @@ def materialize_columns(batch, config, tok_info, seed, scope):
     rn = batch.is_random_next
 
     if not config.masking:
-        # Row i of seq_ids spans [off_i, off_i + seq_lens_i):
-        # [CLS] A [SEP] B [SEP]. Gather A and B id segments flat.
-        offsets = np.cumsum(seq_lens) - seq_lens
-        flat_a = batch.seq_ids[np.repeat(offsets + 1, a_lens)
-                               + concat_aranges(a_lens)]
-        flat_b = batch.seq_ids[np.repeat(offsets + 2 + a_lens, b_lens)
-                               + concat_aranges(b_lens)]
+        if batch.a_ids is not None and batch.b_ids is not None:
+            # Fused-kernel fast path: the flat A/B segments arrived as
+            # ownership-transferred buffers — wrap, don't re-gather.
+            flat_a, flat_b = batch.a_ids, batch.b_ids
+        else:
+            # Row i of seq_ids spans [off_i, off_i + seq_lens_i):
+            # [CLS] A [SEP] B [SEP]. Gather A and B id segments flat.
+            offsets = np.cumsum(seq_lens) - seq_lens
+            flat_a = batch.seq_ids[np.repeat(offsets + 1, a_lens)
+                                   + concat_aranges(a_lens)]
+            flat_b = batch.seq_ids[np.repeat(offsets + 2 + a_lens, b_lens)
+                                   + concat_aranges(b_lens)]
         columns = {
             "A": joined_token_strings(flat_a, a_lens, tok_table),
             "B": joined_token_strings(flat_b, b_lens, tok_table),

@@ -19,6 +19,8 @@ this contract.
 import dataclasses
 import os
 
+import numpy as np
+
 from ..utils.fs import get_all_files_paths_under
 from ..utils import rng as lrng
 
@@ -30,6 +32,58 @@ class Block:
     path: str
     start: int
     end: int  # exclusive
+
+
+class DocSpans:
+    """Zero-copy document view: one contiguous bytes buffer + per-document
+    (start, end) byte ranges. This is how a bucket's documents travel from
+    the spool reader into the native engine — the kernel reads the raw
+    buffer in place, so no per-document Python object (and no re-encoding
+    pass) ever exists on the hot path.
+
+    List-like for the fallback engines: ``len``, iteration and indexing
+    yield each document's bytes (a copy, made only when actually
+    consumed). ``take_`` permutes the view in place — the in-bucket
+    shuffle reorders two int64 arrays instead of a list of objects
+    (utils.rng.shuffle dispatches on it with the identical draw
+    contract)."""
+
+    __slots__ = ("buffer", "starts", "ends")
+
+    def __init__(self, buffer, starts, ends):
+        self.buffer = buffer
+        self.starts = np.ascontiguousarray(starts, dtype=np.int64)
+        self.ends = np.ascontiguousarray(ends, dtype=np.int64)
+
+    @classmethod
+    def from_texts(cls, texts):
+        """Pack a sequence of bytes into one buffer (tests/adapters; the
+        spool reader builds views directly over its merged read buffer)."""
+        texts = [t if isinstance(t, bytes) else t.encode("utf-8")
+                 for t in texts]
+        lens = np.fromiter(map(len, texts), dtype=np.int64,
+                           count=len(texts))
+        ends = np.cumsum(lens)
+        return cls(b"".join(texts), ends - lens, ends)
+
+    def __len__(self):
+        return len(self.starts)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        return bytes(self.buffer[self.starts[i]:self.ends[i]])
+
+    def __iter__(self):
+        buf = self.buffer
+        for s, e in zip(self.starts, self.ends):
+            yield bytes(buf[int(s):int(e)])
+
+    def take_(self, perm):
+        """Reorder documents in place by ``perm`` (offset-array permute)."""
+        self.starts = self.starts[perm]
+        self.ends = self.ends[perm]
+        return self
 
 
 def _find_text_files_under(root):

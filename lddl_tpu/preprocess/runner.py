@@ -325,32 +325,134 @@ def _spool_one_block(block, out_dir, seed, sample_ratio, nbuckets, ngroups,
                                ngroups, spool_name)
 
 
+_WS_TABLE = None  # ASCII whitespace membership (bytes.split(None) set)
+
+
+def _scan_block_documents(block, sample_ratio, base_seed):
+    """Vectorized replay of ``readers.read_documents`` for the scatter:
+    returns (buffer, text_starts, text_ends) where document i's text bytes
+    are ``buffer[text_starts[i]:text_ends[i]]`` — same documents, same
+    order, same per-line sample draws (one bulk ``g.random(n)`` consumes
+    the stream exactly like n scalar draws), but the line split, the
+    blank-line filter and the '<doc id> <text>' parse all run as numpy
+    scans instead of per-line Python."""
+    import numpy as np
+    global _WS_TABLE
+    if _WS_TABLE is None:
+        table = np.zeros(256, dtype=bool)
+        table[[9, 10, 11, 12, 13, 32]] = True  # bytes.strip()/split(None)
+        _WS_TABLE = table
+    with open(block.path, "rb") as f:
+        if block.start == 0:
+            f.seek(0)
+        else:
+            f.seek(block.start - 1)
+            # If the previous byte is not a newline, our start is
+            # mid-line: that line belongs to the previous block.
+            prev = f.read(1)
+            if prev != b"\n":
+                f.readline()
+        pos0 = f.tell()
+        if pos0 >= block.end:
+            z = np.zeros(0, dtype=np.int64)
+            return b"", z, z
+        data = f.read(block.end - pos0)
+        # A line that STARTS inside the block is owned whole: complete a
+        # truncated tail line from beyond the block boundary.
+        if data and not data.endswith(b"\n"):
+            data += f.readline()
+    if not data:
+        z = np.zeros(0, dtype=np.int64)
+        return b"", z, z
+    arr = np.frombuffer(data, dtype=np.uint8)
+    n = len(arr)
+    is_ws = _WS_TABLE[arr]
+    ws_pos = np.flatnonzero(is_ws)  # ~one per word; cheap to search
+    nl = np.flatnonzero(arr == 0x0A)
+    nlines = len(nl) + (0 if (len(nl) and nl[-1] == n - 1) else 1)
+    line_starts = np.zeros(nlines, dtype=np.int64)
+    line_starts[1:] = nl[:nlines - 1] + 1
+    line_ends = np.empty(nlines, dtype=np.int64)
+    line_ends[:len(nl)] = nl[:nlines]
+    if nlines > len(nl):
+        line_ends[-1] = n
+    # id_start: first non-ws byte of the line. Fast path — the line
+    # starts with its doc id (no leading whitespace); the rare
+    # leading-ws/blank lines walk forward in Python.
+    id_start = line_starts.copy()
+    odd = np.flatnonzero(is_ws[np.minimum(line_starts, n - 1)]
+                         | (line_starts >= line_ends))
+    blank = np.zeros(nlines, dtype=bool)
+    for li in odd:
+        j = int(line_starts[li])
+        e = int(line_ends[li])
+        while j < e and is_ws[j]:
+            j += 1
+        if j >= e:
+            blank[li] = True  # `not line.strip()`
+        else:
+            id_start[li] = j
+    id_start = id_start[~blank]
+    nb_ends = line_ends[~blank]
+    # Per-line sample draw (only non-blank lines draw, as in the scalar
+    # path; a kept draw may still yield no document — one bulk
+    # ``g.random(n)`` consumes the stream exactly like n scalar draws).
+    if sample_ratio < 1.0:
+        g = lrng.sample_rng(base_seed, block.block_id)
+        kept = g.random(len(id_start)) < sample_ratio
+        id_start = id_start[kept]
+        nb_ends = nb_ends[kept]
+    # '<doc id> <text...>': text starts at the first non-ws after the
+    # first ws-run following the id token; lines with no text drop.
+    # First ws at/after id_start via ONE searchsorted over ws positions.
+    if len(ws_pos):
+        j = np.searchsorted(ws_pos, id_start)
+        ws_after = np.where(
+            j < len(ws_pos), ws_pos[np.minimum(j, len(ws_pos) - 1)], n)
+    else:
+        ws_after = np.full(len(id_start), n, dtype=np.int64)
+    has_sep = ws_after < nb_ends
+    # Fast path: a single separator byte (text at ws_after + 1); rare
+    # multi-ws separators walk forward in Python.
+    probe = np.minimum(ws_after + 1, n - 1)
+    multi = np.flatnonzero(has_sep & is_ws[probe])
+    text_start = np.where(has_sep, np.minimum(ws_after + 1, n), nb_ends)
+    for li in multi:
+        j2 = int(text_start[li])
+        e = int(nb_ends[li])
+        while j2 < e and is_ws[j2]:
+            j2 += 1
+        text_start[li] = j2
+    has_text = has_sep & (text_start < nb_ends)
+    return data, text_start[has_text], nb_ends[has_text]
+
+
 def _spool_one_block_inner(block, out_dir, seed, sample_ratio, nbuckets,
                            ngroups, spool_name):
+    buf, text_starts, text_ends = _scan_block_documents(
+        block, sample_ratio, seed)
+    mv = memoryview(buf)
     by_group = {}
-    ndocs = nbytes = 0
-    for ordinal, (doc_id, text) in enumerate(
-            read_documents(block, sample_ratio=sample_ratio,
-                           base_seed=seed)):
+    for ordinal in range(len(text_starts)):
         b = _bucket_of(seed, block.block_id, ordinal, nbuckets)
         by_group.setdefault(_group_of_bucket(b, ngroups), {}).setdefault(
-            b, []).append(text)
-        ndocs += 1
-        nbytes += len(text)
-    obs.inc("preprocess_docs_total", ndocs)
-    obs.inc("preprocess_doc_bytes_total", nbytes)
+            b, []).append(ordinal)
+    obs.inc("preprocess_docs_total", len(text_starts))
+    obs.inc("preprocess_doc_bytes_total",
+            int((text_ends - text_starts).sum()))
     spool_root = os.path.join(out_dir, _SPOOL_DIR)
     for g, by_bucket in sorted(by_group.items()):
         group_dir = os.path.join(spool_root, "group-{}".format(g))
         os.makedirs(group_dir, exist_ok=True)
         # Raw bytes end to end (see readers.read_block_lines): document
-        # bytes are appended exactly as read, never decoded.
+        # bytes are appended exactly as read, never decoded — memoryview
+        # slices of the block buffer go straight into writelines.
         parts = []
-        for b, texts in sorted(by_bucket.items()):
+        for b, ordinals in sorted(by_bucket.items()):
             parts.append("#B {} {}\n".format(block.block_id, b).encode())
-            for text in texts:
+            for o in ordinals:
                 parts.append(b" ")
-                parts.append(text)
+                parts.append(mv[text_starts[o]:text_ends[o]])
                 parts.append(b"\n")
         # Guarded append (fault site "open"): spool files are O_APPEND
         # streams, so only the OPEN retries on transient errors — a
@@ -361,49 +463,96 @@ def _spool_one_block_inner(block, out_dir, seed, sample_ratio, nbuckets,
 
 
 def _read_group_texts(out_dir, group, nbuckets, ngroups, accept=None):
-    """Read one coarse spool group once; return {bucket: [texts]} with each
-    bucket's texts in canonical order: blocks sorted by block id as a
-    STRING. (Lex order over digit strings matches the round-2 layout's
-    sorted-"block-<b>.txt"-filename order, keeping shard bytes identical —
-    pinned by tests/golden_spool.json.) Within a block, scatter wrote lines
-    in document order under one "#B" header in one writer's file, so
-    collecting per (bucket, block) and walking blocks in sorted order
-    preserves it regardless of how blocks were dealt to writers.
+    """Read one coarse spool group once; return {bucket: DocSpans} — a
+    ZERO-COPY view per bucket over the group's merged spool bytes (each
+    document is a (start, end) range; the native engine reads the buffer
+    in place and the fallback engines materialize bytes lazily). Each
+    bucket's documents come in canonical order: blocks sorted by block id
+    as a STRING. (Lex order over digit strings matches the round-2
+    layout's sorted-"block-<b>.txt"-filename order, keeping shard bytes
+    identical — pinned by tests/golden_spool.json.) Within a block,
+    scatter wrote lines in document order under one "#B" header in one
+    writer's file, so collecting per (bucket, block) and walking blocks in
+    sorted order preserves it regardless of how blocks were dealt to
+    writers.
+
+    The line parse is vectorized: newline offsets come from one numpy
+    scan, per-line Python happens only at "#B" headers (one per
+    (block, bucket) run, not per document) — this was the 'other_python'
+    sink in PROFILE_PREPROCESS.json before PR 9.
 
     ``accept``: optional collection of exact file names to read — the
     elastic scheduler's epoch fence: only the spool files named by each
     scatter unit's completion record (the winning (epoch, holder) attempt)
     are trusted; a fenced-off zombie's late appends land in files this
     set never names."""
+    import numpy as np
+    from .readers import DocSpans
     group_dir = os.path.join(out_dir, _SPOOL_DIR, "group-{}".format(group))
+    empty = np.zeros(0, dtype=np.int64)
     by_bucket = {b: {} for b in _buckets_of_group(group, nbuckets, ngroups)}
     if not os.path.isdir(group_dir):
-        return {b: [] for b in by_bucket}
+        return {b: DocSpans(b"", empty, empty) for b in by_bucket}
+    # Merge the group's spool files into ONE buffer (guarded reads:
+    # transient EIO/ESTALE on the shared spool retries). Every writer
+    # terminates every line, but a crashed writer may leave a torn tail —
+    # reinsert the newline so file boundaries never fuse lines.
+    datas = []
     for name in sorted(os.listdir(group_dir)):
         if accept is not None and name not in accept:
             continue
-        # Bulk binary read + one C-level split: no per-line decode, no
-        # per-line iterator overhead. Document bytes stay bytes all the
-        # way into the C++ engine. Block keys stay BYTES digit strings —
-        # lex order over ASCII digits matches the old str sort exactly.
-        # Guarded read: transient EIO/ESTALE on the shared spool retries.
         data = rio.read_bytes(os.path.join(group_dir, name))
-        current = None
-        for line in data.split(b"\n"):
-            if line.startswith(b"#B "):
-                hdr = line.split()
-                blocks = (by_bucket.get(int(hdr[2].decode()))
-                          if len(hdr) == 3 else None)
-                current = (None if blocks is None
-                           else blocks.setdefault(hdr[1], []))
-            elif current is not None:
-                text = line[1:]
-                if text:
-                    current.append(text)
-    return {
-        b: [t for _, ts in sorted(blocks.items()) for t in ts]
-        for b, blocks in by_bucket.items()
-    }
+        if data and not data.endswith(b"\n"):
+            data += b"\n"
+        datas.append(data)
+    blob = b"".join(datas)
+    del datas
+    if not blob:
+        return {b: DocSpans(blob, empty, empty) for b in by_bucket}
+    arr = np.frombuffer(blob, dtype=np.uint8)
+    nl = np.flatnonzero(arr == 0x0A)
+    if not len(nl):  # unreachable (files are newline-terminated above)
+        return {b: DocSpans(blob, empty, empty) for b in by_bucket}
+    line_starts = np.empty(len(nl), dtype=np.int64)
+    line_starts[0] = 0
+    line_starts[1:] = nl[:-1] + 1
+    line_ends = nl.astype(np.int64)  # exclusive of the newline
+    # Header lines start with '#'; documents were written as b" " + text.
+    # Only an exact b"#B " prefix is a header (anything else starting '#'
+    # is document text, as in the per-line parser this replaces).
+    hdr_idx = np.flatnonzero(arr[line_starts] == 0x23)
+    runs = []  # (bucket, block_key, first_doc_line, end_doc_line)
+    for pos, h in enumerate(hdr_idx):
+        s, e = int(line_starts[h]), int(line_ends[h])
+        line = blob[s:e]
+        bucket = None
+        if line.startswith(b"#B "):
+            hdr = line.split()
+            if len(hdr) == 3:
+                try:
+                    bucket = int(hdr[2].decode())
+                except ValueError:
+                    bucket = None
+        nxt = (int(hdr_idx[pos + 1]) if pos + 1 < len(hdr_idx)
+               else len(line_starts))
+        if bucket in by_bucket:
+            runs.append((bucket, hdr[1], int(h) + 1, nxt))
+    for bucket, block_key, lo, hi in runs:
+        starts = line_starts[lo:hi] + 1  # skip the leading b" "
+        ends = line_ends[lo:hi]
+        keep = ends > starts  # empty documents are dropped, as before
+        by_bucket[bucket].setdefault(block_key, []).append(
+            (starts[keep], ends[keep]))
+    out = {}
+    for b, blocks in by_bucket.items():
+        if not blocks:
+            out[b] = DocSpans(blob, empty, empty)
+            continue
+        parts = [p for _, chunks in sorted(blocks.items()) for p in chunks]
+        out[b] = DocSpans(blob,
+                          np.concatenate([p[0] for p in parts]),
+                          np.concatenate([p[1] for p in parts]))
+    return out
 
 
 class BertBucketProcessor:

@@ -34,14 +34,17 @@ _WORKER_TAG = 0x1DD1_0002
 _SAMPLE_TAG = 0x1DD1_0003
 
 
-def _generator(*scope):
+def _key_bytes(*scope):
     # Philox is counter-based: a 128-bit key fully determines the stream.
     # Fold the scope tuple into the key with blake2b — stable bytes across
     # numpy/python versions, collision-resistant across scopes.
-    digest = hashlib.blake2b(
-        struct.pack("<{}Q".format(len(scope)), *(int(s) for s in scope)),
+    return hashlib.blake2b(
+        struct.pack("<{}Q".format(len(scope)), *(int(s) % 2**64 for s in scope)),
         digest_size=16).digest()
-    key = np.frombuffer(digest, dtype=np.uint64)
+
+
+def _generator(*scope):
+    key = np.frombuffer(_key_bytes(*scope), dtype=np.uint64)
     return np.random.Generator(np.random.Philox(key=key))
 
 
@@ -76,6 +79,18 @@ def sample_rng(base_seed, *scope):
     return _generator(*key)
 
 
+def sample_key_bytes(base_seed, *scope):
+    """The 16-byte Philox key of ``sample_rng(base_seed, *scope)``'s
+    stream — what the native engine needs to REPLAY that exact stream in
+    C++ (lddl_tpu.native.mask_batch). Frozen alongside the stream layout;
+    tests pin Generator(Philox(key=sample_key_bytes(...))) ==
+    sample_rng(...) draw-for-draw."""
+    key = [_SAMPLE_TAG, np.uint64(base_seed)]
+    for s in scope:
+        key.append(np.uint64(s))
+    return _key_bytes(*key)
+
+
 def shuffle(rng, seq):
     """In-place shuffle of a list using ``rng``.
 
@@ -86,6 +101,12 @@ def shuffle(rng, seq):
     environments. Stream contract: one ``random(len(seq))`` draw per call.
     """
     perm = np.argsort(rng.random(len(seq)), kind="stable")
+    if hasattr(seq, "take_"):
+        # Zero-copy span views (readers.DocSpans) permute their offset
+        # arrays in place — same single-draw stream contract, no per-doc
+        # Python objects.
+        seq.take_(perm)
+        return seq
     seq[:] = [seq[i] for i in perm]
     return seq
 

@@ -1,0 +1,413 @@
+"""Fused zero-copy preprocess hot path: byte identity + replay contracts.
+
+PR 9 collapses the per-bucket BERT pipeline into one native pass
+(lddl_bert_instances: split + normalize + WordPiece + NSP pairs) fed
+zero-copy from the spool reader (readers.DocSpans) and drained zero-copy
+into Arrow buffers, plus a native replay of the numpy static-masking
+stream (lddl_mask_batch). Every rung of the runtime ladder
+(fused -> staged native -> hf) must emit byte-identical shards; these
+tests pin that, the numpy-Philox replay contract, the vectorized spool
+parsers, and the .so staleness metadata.
+"""
+
+import gc
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from lddl_tpu import native
+from lddl_tpu.preprocess import build_wordpiece_vocab, get_tokenizer
+from lddl_tpu.preprocess.bert import TokenizerInfo
+from lddl_tpu.utils import rng as lrng
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native engine did not build")
+
+from test_native import DOCS  # noqa: E402  (shared corpus fixture)
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("fvocab") / "vocab.txt"
+    return build_wordpiece_vocab(DOCS * 3, str(path), vocab_size=400)
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(vocab_file):
+    return get_tokenizer(vocab_file=vocab_file)
+
+
+@pytest.fixture()
+def corpus_dir(tmp_path):
+    source = tmp_path / "corpus" / "source"
+    source.mkdir(parents=True)
+    with open(source / "0.txt", "w", encoding="utf-8") as f:
+        for i, d in enumerate(DOCS * 4):
+            if d.strip():
+                f.write("doc-{} {}\n".format(i, d.replace("\n", " ")
+                                             .replace("\r", " ")
+                                             .replace("\t", " ")
+                                             .replace("\x00", "")))
+    return str(tmp_path / "corpus")
+
+
+def _shard_hashes(out_dir):
+    digests = {}
+    for name in sorted(os.listdir(out_dir)):
+        if "parquet" in name or name.endswith(".txt"):
+            with open(os.path.join(out_dir, name), "rb") as f:
+                digests[name] = hashlib.sha256(f.read()).hexdigest()
+    return digests
+
+
+# ---------------------------------------------------------------------------
+# numpy-Philox replay (the masking stream contract)
+# ---------------------------------------------------------------------------
+
+
+def test_philox_replay_parity():
+    """sample_key_bytes reconstructs sample_rng's exact stream: the key is
+    the whole contract the C++ replay builds on."""
+    for seed, scope in [(0, ()), (12345, (0x3A5C, 7)), (99, (1, 2, 3))]:
+        key = lrng.sample_key_bytes(seed, *scope)
+        g = np.random.Generator(
+            np.random.Philox(key=np.frombuffer(key, dtype=np.uint64)))
+        ref = lrng.sample_rng(seed, *scope)
+        assert np.array_equal(g.random(17), ref.random(17))
+        assert np.array_equal(g.integers(0, 30522, 17, dtype=np.int64),
+                              ref.integers(0, 30522, 17, dtype=np.int64))
+
+
+def test_native_mask_matches_numpy():
+    """The C++ masking kernel is a bit-exact replay of mask_batch_numpy on
+    the same stream — shapes, vocab sizes and degenerate rows included."""
+    from lddl_tpu.ops.masking import mask_batch_numpy
+    g0 = np.random.default_rng(11)
+    cases = [(0, 16, 100), (1, 8, 2), (5, 128, 30522), (40, 128, 377),
+             (17, 64, 4_000_000), (3, 128, 30522)]
+    for trial, (n, width, vocab) in enumerate(cases):
+        ids = g0.integers(0, vocab, (n, width)).astype(np.int32)
+        cand = g0.random((n, width)) < 0.6
+        ntp = g0.integers(0, 30, n).astype(np.int64)
+        if n:
+            ntp[0] = 0            # selected[ntp<=0] = False branch
+            cand[-1] = False      # all-inf row
+        key = lrng.sample_key_bytes(7, 0x3A5C, trial)
+        got = native.mask_batch(key, ids, cand, ntp, 4, vocab)
+        assert got is not None
+        m_ref, s_ref = mask_batch_numpy(ids, cand, ntp,
+                                        lrng.sample_rng(7, 0x3A5C, trial),
+                                        4, vocab)
+        np.testing.assert_array_equal(got[0], m_ref)
+        np.testing.assert_array_equal(got[1], s_ref)
+
+
+def test_native_mask_refuses_out_of_contract_vocab():
+    """vocab sizes outside [2, 2^32) fall back to numpy (return None)
+    instead of silently diverging from the frozen integers replay."""
+    ids = np.zeros((2, 8), dtype=np.int32)
+    cand = np.ones((2, 8), dtype=bool)
+    ntp = np.ones(2, dtype=np.int64)
+    key = lrng.sample_key_bytes(1)
+    assert native.mask_batch(key, ids, cand, ntp, 0, 1) is None
+    assert native.mask_batch(key, ids, cand, ntp, 0, 2**33) is None
+
+
+# ---------------------------------------------------------------------------
+# Fused kernel vs staged engine (in-process arrays)
+# ---------------------------------------------------------------------------
+
+
+def test_fused_matches_staged_arrays(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d for d in DOCS if d.strip()] * 4
+    for seed, bucket in [(0, 0), (12345, 7)]:
+        ids, sl, dc = nat.tokenize_docs(texts)
+        ref = native.bert_pairs(ids, sl, dc, 48, 0.1, 3, seed, bucket,
+                                info.cls_id, info.sep_id)
+        got = nat.bert_instances(texts, 48, 0.1, 3, seed, bucket,
+                                 info.cls_id, info.sep_id, want_ab=True)
+        seq_ids, seq_lens, a_lens, rn, a_ids, b_ids = got
+        np.testing.assert_array_equal(seq_ids, ref[0])
+        np.testing.assert_array_equal(seq_lens, ref[1])
+        np.testing.assert_array_equal(a_lens, ref[2])
+        np.testing.assert_array_equal(rn, ref[3])
+        # want_ab: the flat A/B segments must equal the per-row slices.
+        offs = np.cumsum(seq_lens) - seq_lens
+        flat_a = ref[0][np.concatenate(
+            [np.arange(o + 1, o + 1 + a)
+             for o, a in zip(offs, a_lens)]).astype(np.int64)] \
+            if len(a_lens) else np.zeros(0, np.int32)
+        np.testing.assert_array_equal(a_ids, flat_a)
+        assert len(b_ids) == len(seq_ids) - len(a_ids) - 3 * len(seq_lens)
+
+
+def test_fused_accepts_doc_spans(hf_tokenizer):
+    """DocSpans input (the zero-copy spool view) tokenizes identically to
+    the packed list path, including after an offset-array shuffle."""
+    from lddl_tpu.preprocess.readers import DocSpans
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d.encode("utf-8") for d in DOCS if d.strip()] * 3
+    spans = DocSpans.from_texts(texts)
+    g1 = lrng.sample_rng(5, 0x9A1A, 3)
+    g2 = lrng.sample_rng(5, 0x9A1A, 3)
+    shuffled_list = lrng.shuffle(g1, list(texts))
+    lrng.shuffle(g2, spans)
+    assert list(spans) == shuffled_list  # same single-draw contract
+    a = nat.bert_instances(spans, 48, 0.1, 2, 9, 1, info.cls_id,
+                           info.sep_id)
+    b = nat.bert_instances(shuffled_list, 48, 0.1, 2, 9, 1, info.cls_id,
+                           info.sep_id)
+    for x, y in zip(a[:4], b[:4]):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_owned_buffers_are_zero_copy_and_survive_release(hf_tokenizer):
+    """Result arrays wrap kernel buffers (no .copy() at the boundary) and
+    stay valid after the result struct is released and the tokenizer
+    handle goes away; finalizers free without crashing."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    ids, sl, dc = nat.tokenize_docs([d for d in DOCS if d.strip()])
+    assert not ids.flags.owndata  # wraps the kernel's buffer
+    snapshot = ids.copy()
+    view = ids[1:]
+    del ids
+    gc.collect()
+    np.testing.assert_array_equal(view, snapshot[1:])  # base chain holds
+    del view, sl, dc
+    gc.collect()  # finalizers run; must not crash or double-free
+
+
+# ---------------------------------------------------------------------------
+# End-to-end shard byte identity across the engine ladder
+# ---------------------------------------------------------------------------
+
+
+def _run_bert(corpus_dir, out, tokenizer, monkeypatch=None, env=None,
+              **kwargs):
+    from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
+    cfg = dict(max_seq_length=48, duplicate_factor=2, masking=True,
+               tokenizer_engine="native")
+    cfg.update({k: kwargs.pop(k) for k in list(kwargs)
+                if k in ("masking", "tokenizer_engine", "schema_version")})
+    for key, value in (env or {}).items():
+        monkeypatch.setenv(key, value)
+    try:
+        run_bert_preprocess(
+            {"wikipedia": corpus_dir}, out, tokenizer,
+            config=BertPretrainConfig(**cfg),
+            num_blocks=3, sample_ratio=1.0, seed=7, **kwargs)
+    finally:
+        for key in (env or {}):
+            monkeypatch.delenv(key, raising=False)
+    return _shard_hashes(out)
+
+
+def test_fused_identity_smoke(hf_tokenizer, corpus_dir, tmp_path,
+                              monkeypatch):
+    """CI smoke: masked + binned + schema-v2 shards are byte-identical
+    across fused / staged / hf."""
+    fused = _run_bert(corpus_dir, str(tmp_path / "fused"), hf_tokenizer,
+                      monkeypatch, bin_size=16)
+    staged = _run_bert(corpus_dir, str(tmp_path / "staged"), hf_tokenizer,
+                       monkeypatch, env={"LDDL_TPU_NATIVE_FUSED": "0"},
+                       bin_size=16)
+    hf = _run_bert(corpus_dir, str(tmp_path / "hf"), hf_tokenizer,
+                   monkeypatch, tokenizer_engine="hf", bin_size=16)
+    assert fused == staged == hf
+    assert fused
+
+
+def test_fused_identity_unbinned_unmasked(hf_tokenizer, corpus_dir,
+                                          tmp_path, monkeypatch):
+    """The want_ab fast path (kernel-emitted A/B segments feeding the
+    schema-v2 columns) changes no bytes."""
+    fused = _run_bert(corpus_dir, str(tmp_path / "fused"), hf_tokenizer,
+                      monkeypatch, masking=False)
+    staged = _run_bert(corpus_dir, str(tmp_path / "staged"), hf_tokenizer,
+                       monkeypatch, env={"LDDL_TPU_NATIVE_FUSED": "0"},
+                       masking=False)
+    hf = _run_bert(corpus_dir, str(tmp_path / "hf"), hf_tokenizer,
+                   monkeypatch, tokenizer_engine="hf", masking=False)
+    assert fused == staged == hf
+    assert fused
+
+
+def test_fused_identity_schema_v1(hf_tokenizer, corpus_dir, tmp_path,
+                                  monkeypatch):
+    fused = _run_bert(corpus_dir, str(tmp_path / "fused"), hf_tokenizer,
+                      monkeypatch, schema_version=1)
+    hf = _run_bert(corpus_dir, str(tmp_path / "hf"), hf_tokenizer,
+                   monkeypatch, tokenizer_engine="hf", schema_version=1)
+    assert fused == hf
+    assert fused
+
+
+def test_fused_identity_across_process_pool(hf_tokenizer, corpus_dir,
+                                            tmp_path, monkeypatch):
+    """The fused engine rebuilt behind the pickle boundary (spawned pool
+    workers) emits the same bytes as the serial staged engine."""
+    pooled = _run_bert(corpus_dir, str(tmp_path / "pool"), hf_tokenizer,
+                       monkeypatch, bin_size=16, num_workers=2)
+    serial = _run_bert(corpus_dir, str(tmp_path / "serial"), hf_tokenizer,
+                       monkeypatch, env={"LDDL_TPU_NATIVE_FUSED": "0"},
+                       bin_size=16)
+    assert pooled == serial
+    assert pooled
+
+
+def test_bart_native_split_identity(corpus_dir, tmp_path, monkeypatch):
+    """BART's whole-bucket native sentence split (zero-copy spool view in,
+    byte ranges out) produces shards byte-identical to the Python
+    splitter path."""
+    from lddl_tpu.preprocess import BartPretrainConfig, run_bart_preprocess
+
+    def run(out, force_python):
+        if force_python:
+            monkeypatch.setenv("LDDL_TPU_BART_NATIVE_SPLIT", "0")
+        else:
+            monkeypatch.delenv("LDDL_TPU_BART_NATIVE_SPLIT", raising=False)
+        run_bart_preprocess(
+            {"wikipedia": corpus_dir}, out,
+            config=BartPretrainConfig(target_seq_length=48),
+            num_blocks=3, sample_ratio=1.0, seed=11)
+        return _shard_hashes(out)
+
+    a = run(str(tmp_path / "native"), force_python=False)
+    b = run(str(tmp_path / "python"), force_python=True)
+    assert a == b
+    assert a
+
+
+# ---------------------------------------------------------------------------
+# Vectorized spool parsers == scalar reference semantics
+# ---------------------------------------------------------------------------
+
+
+def test_scan_block_documents_matches_read_documents(tmp_path):
+    """The scatter's vectorized block scanner replays read_documents
+    exactly: blank lines, leading whitespace, multi-ws separators,
+    id-only lines, sampling draws and block-boundary line snapping."""
+    from lddl_tpu.preprocess.readers import Block, read_documents
+    from lddl_tpu.preprocess.runner import _scan_block_documents
+    path = tmp_path / "block.txt"
+    lines = [
+        b"doc-0 plain text line",
+        b"",
+        b"   ",
+        b"\tdoc-1 leading tab id",
+        b"doc-2\t\t  multi separator   text  ",
+        b"doc-3",            # id only -> dropped
+        b"doc-4 x",
+        b"  doc-5   spaced everywhere ",
+        b"doc-6 tail line no newline",
+    ]
+    data = b"\n".join(lines)
+    path.write_bytes(data)
+    size = len(data)
+    # several byte ranges incl. mid-line starts and ends
+    for start, end in [(0, size), (0, 10), (5, 40), (22, size - 3),
+                       (size - 5, size), (0, 1)]:
+        for ratio in (1.0, 0.6):
+            block = Block(3, str(path), start, end)
+            ref = [text for _, text in read_documents(
+                block, sample_ratio=ratio, base_seed=99)]
+            buf, starts, ends = _scan_block_documents(block, ratio, 99)
+            got = [bytes(buf[s:e]) for s, e in zip(starts, ends)]
+            assert got == ref, (start, end, ratio)
+
+
+def test_read_group_texts_matches_scalar_reference(tmp_path):
+    """The vectorized gather parser (DocSpans out) reproduces the old
+    per-line parser's documents, order and edge cases: interleaved
+    headers, malformed headers, '#'-prefixed document text, empty lines,
+    torn (newline-less) tails, multiple files, accept filtering."""
+    from lddl_tpu.preprocess.runner import _SPOOL_DIR, _read_group_texts
+    out_dir = tmp_path
+    gdir = tmp_path / _SPOOL_DIR / "group-1"
+    gdir.mkdir(parents=True)
+    (gdir / "w0-1.txt").write_bytes(
+        b"#B 7 1\n doc a\n doc b\n"
+        b"#B 3 5\n doc c\n\n d\n"
+        b"#B bad\n ignored after malformed\n"
+        b"#B 3 1\n back to bucket 1\n #hash doc text\n")
+    (gdir / "w1-2.txt").write_bytes(
+        b"#B 7 5\n another\n \n"      # " " -> empty doc dropped
+        b"#B 7 1\n same unit second file\n torn tail")
+    (gdir / "zz-ignored.txt").write_bytes(b"#B 9 1\n fenced out\n")
+
+    def scalar_reference(names):
+        by_bucket = {b: {} for b in (1, 5)}  # group 1 of 4 groups, 8 buckets
+        for name in names:
+            data = (gdir / name).read_bytes()
+            current = None
+            for line in data.split(b"\n"):
+                if line.startswith(b"#B "):
+                    hdr = line.split()
+                    blocks = (by_bucket.get(int(hdr[2].decode()))
+                              if len(hdr) == 3 else None)
+                    current = (None if blocks is None
+                               else blocks.setdefault(hdr[1], []))
+                elif current is not None:
+                    text = line[1:]
+                    if text:
+                        current.append(text)
+        return {b: [t for _, ts in sorted(blocks.items()) for t in ts]
+                for b, blocks in by_bucket.items()}
+
+    accept = {"w0-1.txt", "w1-2.txt"}
+    expected = scalar_reference(sorted(accept))
+    got = _read_group_texts(str(out_dir), 1, 8, 4, accept=accept)
+    assert set(got) == set(expected)
+    for b in expected:
+        assert [bytes(t) for t in got[b]] == expected[b], b
+    # no accept filter: the zz file joins in sorted order
+    expected_all = scalar_reference(sorted(os.listdir(gdir)))
+    got_all = _read_group_texts(str(out_dir), 1, 8, 4)
+    for b in expected_all:
+        assert [bytes(t) for t in got_all[b]] == expected_all[b], b
+
+
+def test_doc_spans_view_semantics():
+    from lddl_tpu.preprocess.readers import DocSpans
+    texts = [b"alpha", b"", b"gamma delta", b"z"]
+    spans = DocSpans.from_texts(texts)
+    assert len(spans) == 4
+    assert list(spans) == texts
+    assert spans[2] == b"gamma delta"
+    assert spans[1:3] == [b"", b"gamma delta"]
+    spans.take_(np.array([3, 0, 2, 1]))
+    assert list(spans) == [b"z", b"alpha", b"gamma delta", b""]
+
+
+# ---------------------------------------------------------------------------
+# .so staleness: the cached binary must carry a digest of its sources
+# ---------------------------------------------------------------------------
+
+
+def test_so_meta_pins_source_digest():
+    """A freshly ensured .so records a digest of lddl_native.cpp +
+    unicode_tables.h; content drift (even with preserved mtimes) then
+    fails the staleness check loudly instead of serving old kernels."""
+    from lddl_tpu.native import build
+    path = build.ensure_built()
+    assert path is not None
+    with open(build.LIB_META) as f:
+        meta = f.read().strip()
+    digest = build.source_digest()
+    assert "src=" + digest in meta
+    assert not build._lib_stale()
+    # Simulate a stale binary: meta recorded for different sources.
+    try:
+        with open(build.LIB_META, "w") as f:
+            f.write(meta.replace("src=" + digest, "src=" + "0" * 16))
+        assert build._lib_stale()
+    finally:
+        with open(build.LIB_META, "w") as f:
+            f.write(meta + "\n")
+    assert not build._lib_stale()

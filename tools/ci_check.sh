@@ -48,4 +48,18 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
         echo "ci_check: loader_bench smoke FAILED (non-gating, ignored)" >&2
     fi
 fi
+
+# Opt-in native-engine smoke: builds the C++ engine from source and runs
+# the fused-vs-staged-vs-hf shard byte-identity test (the contract the
+# fused hot path lives under). GATING when requested: a build that
+# silently fell back to the hf engine would pass the identity test
+# vacuously, so the build step itself must succeed too. Opt-in via
+# LDDL_TPU_CI_SMOKE_NATIVE=1 (costs ~a minute; the static gate itself
+# must stay sub-second).
+if [ "${LDDL_TPU_CI_SMOKE_NATIVE:-0}" = "1" ]; then
+    JAX_PLATFORMS=cpu python -m lddl_tpu.native.build
+    JAX_PLATFORMS=cpu python -m pytest tests/test_fused.py -q \
+        -k "identity_smoke or mask_matches" -p no:cacheprovider
+    echo "ci_check: native fused identity smoke passed"
+fi
 echo "ci_check: OK"
