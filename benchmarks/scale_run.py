@@ -249,8 +249,8 @@ def main():
             os.path.getsize(os.path.join(sim_corpus, "source", f))
             for f in os.listdir(os.path.join(sim_corpus, "source")))
 
-        def elastic_cli(sink, holder):
-            return [sys.executable, "-m",
+        def elastic_cli(sink, holder, fleet=False):
+            argv = [sys.executable, "-m",
                     "lddl_tpu.cli.preprocess_bert_pretrain",
                     "--wikipedia", sim_corpus, "--sink", sink,
                     "--vocab-file", vocab, "--masking", "--bin-size", "64",
@@ -258,6 +258,13 @@ def main():
                     "--sample-ratio", "0.9", "--local-workers", "1",
                     "--elastic", "--lease-ttl", "10",
                     "--elastic-host-id", holder]
+            if fleet:
+                # Per-host telemetry spools under <sink>/.telemetry/ —
+                # phase 5b's kill scenario then doubles as the fleet
+                # acceptance run: pipeline_status must reconstruct the
+                # cluster's story from the spools alone.
+                argv.append("--fleet-telemetry")
+            return argv
 
         def count_samples(sink):
             n = 0
@@ -295,8 +302,9 @@ def main():
         log_files = [open(p, "w") for p in log_paths]
         env0 = dict(_env(), JAX_PLATFORMS="cpu")
         env0["LDDL_TPU_FAULTS"] = "replace:kill:nth=1:path=_done/group-"
+        env0["LDDL_TPU_FLEET_INTERVAL_S"] = "1"
         procs = [subprocess.Popen(
-            elastic_cli(sim_out, "host0"), env=env0,
+            elastic_cli(sim_out, "host0", fleet=True), env=env0,
             stdout=log_files[0], stderr=subprocess.STDOUT)]
         sc_records = os.path.join(sim_out, "_done")
         deadline = time.time() + 120
@@ -308,8 +316,9 @@ def main():
             time.sleep(0.2)
         for rank in range(1, n_hosts):
             procs.append(subprocess.Popen(
-                elastic_cli(sim_out, "host{}".format(rank)),
-                env=dict(_env(), JAX_PLATFORMS="cpu"),
+                elastic_cli(sim_out, "host{}".format(rank), fleet=True),
+                env=dict(_env(), JAX_PLATFORMS="cpu",
+                         LDDL_TPU_FLEET_INTERVAL_S="1"),
                 stdout=log_files[rank], stderr=subprocess.STDOUT))
         for q in procs:
             try:
@@ -360,6 +369,40 @@ def main():
             "mb_per_s_1proc": round(mbps_1p, 2),
             "mb_per_s_nproc": round(mbps_np, 2),
             "scaling_ratio": round(mbps_np / max(mbps_1p, 1e-9), 2),
+        }
+        # Fleet-telemetry acceptance, from the spool artifacts alone:
+        # pipeline_status --json must see the SIGKILLed host as the one
+        # stalled host and total the journaled ground truth; the merged
+        # Chrome trace must span every host (victim's pre-kill buffer
+        # included). Exit 2 = unhealthy-by-design (the dead host).
+        merged_trace = os.path.join(tmp, "fleet_merged_trace.json")
+        status = subprocess.run(
+            [sys.executable, "-m", "tools.pipeline_status", sim_out,
+             "--json", "--merge-trace", merged_trace],
+            env=dict(_env(), JAX_PLATFORMS="cpu"), capture_output=True,
+            text=True)
+        assert status.returncode == 2, (status.returncode, status.stderr)
+        fleet_report = json.loads(status.stdout)
+        assert fleet_report["health"]["stalled_hosts"] == ["host0"]
+        assert (fleet_report["totals"]["counters"]["units_completed"]
+                == sum(h.get("units_completed", 0)
+                       for h in per_host.values())
+                + fleet_report["hosts"]["host0"]["counters"]
+                ["units_completed"])
+        with open(merged_trace) as f:
+            lanes = {ev["args"]["name"].split(" ")[0] for ev in json.load(f)
+                     if ev.get("ph") == "M"
+                     and ev.get("name") == "process_name"}
+        assert lanes == {"host{}".format(r) for r in range(n_hosts)}, lanes
+        payload["phases"]["elastic_worksteal"]["fleet"] = {
+            "stalled_hosts": fleet_report["health"]["stalled_hosts"],
+            "verdicts": fleet_report["health"]["verdicts"],
+            "units_total": fleet_report["totals"]["counters"]
+            ["units_completed"],
+            "steals_total": fleet_report["totals"]["counters"]["steals"],
+            "fence_rejects_total": fleet_report["totals"]["counters"]
+            ["fence_rejects"],
+            "merged_trace_lanes": sorted(lanes),
         }
         print(payload["phases"]["elastic_worksteal"], flush=True)
 

@@ -30,13 +30,18 @@ SHARD_PKGS = ("lddl_tpu/preprocess/*", "lddl_tpu/balance/*",
 SANCTIONED = ("lddl_tpu/resilience/io.py",)
 
 # Files whose raw writes never land in shard directories by construction
-# (trace/metrics files, generated C++ build trees, pre-pipeline downloads,
-# the analyzer's own cache, test-only fault latches) — excluded as
-# publish-path effect SOURCES so a shard-package call into them is not a
-# publish violation.
+# (trace/metrics files and the fleet-telemetry spools under .telemetry/,
+# generated C++ build trees, pre-pipeline downloads, the analyzer's own
+# cache, test-only fault latches, merged-trace/report artifacts from the
+# status tools) — excluded as publish-path effect SOURCES so a
+# shard-package call into them is not a publish violation. A raw shard
+# write anywhere else on a shard-package call path is still caught
+# (fixture-pinned in tests/test_dataflow.py).
 PUBLISH_SOURCE_EXEMPT = (
     "lddl_tpu/observability/*", "lddl_tpu/analysis/*", "lddl_tpu/native/*",
     "lddl_tpu/download/*", "lddl_tpu/resilience/faults.py",
+    "tools/pipeline_status.py", "tools/trace_summary.py",
+    "tools/bench_trajectory.py",
 )
 
 

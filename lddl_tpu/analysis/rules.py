@@ -256,13 +256,18 @@ class SwallowedErrorRule(Rule):
 
 # -------------------------------------------------------------- stage-span
 
-# Stage entry points that must open their top-level span so every trace
-# carries the stage skeleton (span names are stable API — README table).
-# Migrated from the grep lint in tests/test_observability.py.
+# Stage entry points that must open their top-level span(s) so every
+# trace carries the stage skeleton (span names are stable API — README
+# table). Migrated from the grep lint in tests/test_observability.py;
+# the elastic claim loop and the streaming-ingest service joined when
+# fleet telemetry made their spans part of the cross-host merged trace.
 STAGE_SPANS = {
-    "lddl_tpu/preprocess/runner.py": "preprocess.run",
-    "lddl_tpu/balance/balancer.py": "balance.run",
-    "lddl_tpu/loader/dataloader.py": "loader.epoch",
+    "lddl_tpu/preprocess/runner.py": ("preprocess.run",),
+    "lddl_tpu/preprocess/steal.py": ("preprocess.gather",
+                                     "preprocess.finalize"),
+    "lddl_tpu/balance/balancer.py": ("balance.run",),
+    "lddl_tpu/loader/dataloader.py": ("loader.epoch",),
+    "lddl_tpu/ingest/incremental.py": ("ingest.run",),
 }
 
 
@@ -270,14 +275,16 @@ STAGE_SPANS = {
 class StageSpanRule(Rule):
     id = "stage-span"
     doc = ("each pipeline stage entry file must open its top-level "
-           "obs.span (preprocess.run / balance.run / loader.epoch) so "
-           "traces always carry the stage skeleton")
+           "obs.span (preprocess.run / preprocess.gather+finalize / "
+           "balance.run / loader.epoch / ingest.run) so traces always "
+           "carry the stage skeleton")
     only = tuple(STAGE_SPANS)
 
     def run(self, ctx):
-        want = STAGE_SPANS.get(ctx.path)
-        if want is None:
+        wanted = STAGE_SPANS.get(ctx.path)
+        if not wanted:
             return
+        found = set()
         for node in ast.walk(ctx.tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -285,14 +292,17 @@ class StageSpanRule(Rule):
             if not name or not (name == "span" or name.endswith(".span")):
                 continue
             if (node.args and isinstance(node.args[0], ast.Constant)
-                    and node.args[0].value == want):
-                return
-        # Required-pattern rule: no single node is "the" violation, so the
-        # finding anchors to line 1 of the file.
-        yield Finding(self.id, ctx.path, 1, 0,
-                      "stage entry point lacks its top-level "
-                      "span(\"{}\") — traces from this stage lose "
-                      "their skeleton".format(want), ctx.snippet_at(1))
+                    and node.args[0].value in wanted):
+                found.add(node.args[0].value)
+        for want in wanted:
+            if want in found:
+                continue
+            # Required-pattern rule: no single node is "the" violation, so
+            # the finding anchors to line 1 of the file.
+            yield Finding(self.id, ctx.path, 1, 0,
+                          "stage entry point lacks its top-level "
+                          "span(\"{}\") — traces from this stage lose "
+                          "their skeleton".format(want), ctx.snippet_at(1))
 
 
 # --------------------------------------------------------- jit-host-effect

@@ -66,6 +66,37 @@ def attach_elastic_args(parser):
              "min(blocks, max(16, blocks/16)))")
 
 
+def attach_fleet_arg(parser):
+    parser.add_argument(
+        "--fleet-telemetry", action="store_true",
+        help="publish per-host telemetry spools (registry snapshots + "
+             "unit/generation lifecycle event logs + traces) under "
+             "<sink>/.telemetry/<holder>/ for cross-host aggregation; "
+             "inspect with `python -m tools.pipeline_status <sink>` "
+             "(equivalent to LDDL_TPU_FLEET_DIR=<sink>)")
+
+
+def arm_fleet_if_requested(args, sink):
+    """Arm fleet telemetry into the run's output dir when requested
+    (--fleet-telemetry, or the env var set by the operator). The elastic
+    holder id doubles as the spool name so lease events and spool dirs
+    name the same host — and when the operator gave no --elastic-host-id
+    on an elastic run, ONE auto-generated lease holder is pinned into
+    args here so the spool and the lease files still share a name
+    (configure() would otherwise pin a hostname-pid default that the
+    runner's later adopt_holder() could no longer override)."""
+    if not getattr(args, "fleet_telemetry", False):
+        return
+    holder = getattr(args, "elastic_host_id", None)
+    if holder is None and getattr(args, "elastic", False):
+        from ..resilience import leases
+        holder = leases.default_holder()
+        args.elastic_host_id = holder
+    from ..observability import fleet
+    fleet.configure(sink, holder_id=holder,
+                    ttl=getattr(args, "lease_ttl", None))
+
+
 def elastic_kwargs_of(args):
     if getattr(args, "elastic", False) and getattr(args, "multihost", False):
         raise SystemExit(

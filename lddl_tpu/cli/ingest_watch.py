@@ -10,7 +10,8 @@ resumes from its intake record, and the journal commit is atomic.
 
 from ..preprocess import BertPretrainConfig, get_tokenizer
 from ..utils.args import attach_bool_arg
-from .common import (attach_elastic_args, elastic_kwargs_of, make_parser)
+from .common import (arm_fleet_if_requested, attach_elastic_args,
+                     attach_fleet_arg, elastic_kwargs_of, make_parser)
 
 
 def attach_args(parser=None):
@@ -67,6 +68,7 @@ def attach_args(parser=None):
                              "maintenance windows — not while a loader "
                              "streams the directory mid-epoch")
     attach_elastic_args(parser)
+    attach_fleet_arg(parser)
     return parser
 
 
@@ -74,6 +76,10 @@ def main(args=None):
     args = args if args is not None else attach_args().parse_args()
     if args.vocab_file is None and args.tokenizer is None:
         raise SystemExit("need --vocab-file or --tokenizer")
+    # Arm BEFORE snapshotting the elastic kwargs: on an elastic run
+    # with no --elastic-host-id this pins the auto-generated lease
+    # holder into args so spool and lease files share a name.
+    arm_fleet_if_requested(args, args.sink)
     elastic_kwargs = elastic_kwargs_of(args)
     tokenizer = get_tokenizer(vocab_file=args.vocab_file,
                               pretrained_model_name=args.tokenizer)

@@ -6,9 +6,10 @@ Reference parity: the ``preprocess_bart_pretrain`` console script
 
 from ..preprocess import BartPretrainConfig, run_bart_preprocess
 from ..utils.args import attach_bool_arg
-from .common import (attach_corpus_args, attach_elastic_args,
-                     attach_multihost_arg, communicator_of, corpus_paths_of,
-                     elastic_kwargs_of, make_parser)
+from .common import (arm_fleet_if_requested, attach_corpus_args,
+                     attach_elastic_args, attach_fleet_arg,
+                     attach_multihost_arg, communicator_of,
+                     corpus_paths_of, elastic_kwargs_of, make_parser)
 
 
 def attach_args(parser=None):
@@ -16,6 +17,7 @@ def attach_args(parser=None):
     attach_corpus_args(parser)
     attach_multihost_arg(parser)
     attach_elastic_args(parser)
+    attach_fleet_arg(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True)
     parser.add_argument("--vocab-file", default=None,
                         help="emit schema-v2 token-id columns "
@@ -51,6 +53,10 @@ def attach_args(parser=None):
 def main(args=None):
     import os
     args = args if args is not None else attach_args().parse_args()
+    # Arm BEFORE snapshotting the elastic kwargs: on an elastic run
+    # with no --elastic-host-id this pins the auto-generated lease
+    # holder into args so spool and lease files share a name.
+    arm_fleet_if_requested(args, args.sink)
     elastic_kwargs = elastic_kwargs_of(args)
     comm = communicator_of(args)
     tokenizer = None

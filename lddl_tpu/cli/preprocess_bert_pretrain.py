@@ -8,9 +8,10 @@ the static-scheduled runner's (--num-blocks, --multihost) and the new
 
 from ..preprocess import BertPretrainConfig, get_tokenizer, run_bert_preprocess
 from ..utils.args import attach_bool_arg
-from .common import (attach_corpus_args, attach_elastic_args,
-                     attach_multihost_arg, communicator_of, corpus_paths_of,
-                     elastic_kwargs_of, make_parser)
+from .common import (arm_fleet_if_requested, attach_corpus_args,
+                     attach_elastic_args, attach_fleet_arg,
+                     attach_multihost_arg, communicator_of,
+                     corpus_paths_of, elastic_kwargs_of, make_parser)
 
 
 def attach_args(parser=None):
@@ -18,6 +19,7 @@ def attach_args(parser=None):
     attach_corpus_args(parser)
     attach_multihost_arg(parser)
     attach_elastic_args(parser)
+    attach_fleet_arg(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True,
                         help="output directory for the parquet shards")
     parser.add_argument("--vocab-file", default=None)
@@ -75,6 +77,10 @@ def main(args=None):
     args = args if args is not None else attach_args().parse_args()
     if args.vocab_file is None and args.tokenizer is None:
         raise SystemExit("need --vocab-file or --tokenizer")
+    # Arm BEFORE snapshotting the elastic kwargs: on an elastic run
+    # with no --elastic-host-id this pins the auto-generated lease
+    # holder into args so spool and lease files share a name.
+    arm_fleet_if_requested(args, args.sink)
     elastic_kwargs = elastic_kwargs_of(args)
     comm = communicator_of(args)
     tokenizer = get_tokenizer(vocab_file=args.vocab_file,

@@ -178,6 +178,10 @@ def ingest_once(
     loader is streaming the directory mid-epoch.
     """
     log = log or (lambda msg: None)
+    # Long-lived service: heartbeats must run even on noop rounds so the
+    # fleet status report can tell "idle" from "dead" (no-op when fleet
+    # telemetry is not armed).
+    obs.fleet.ensure_started()
     with obs.span("ingest.run", root=root):
         return _ingest_once_body(
             root, tokenizer, landing, files, config, num_shards, bin_size,
@@ -238,6 +242,8 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
                 "arguments".format(generation, pending.get("fingerprint"),
                                    fingerprint))
         intake = pending
+        obs.fleet.record("generation.intake", generation=generation,
+                         docs=len(intake["hashes"]), resumed=True)
         log("ingest: resuming in-flight generation {} ({} document(s) "
             "from its intake record)".format(generation,
                                              len(intake["hashes"])))
@@ -245,8 +251,13 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
         new_docs, scan_stats = journal_mod.diff_landing(
             journal, landing=landing, files=files)
         obs.inc("ingest_docs_seen_total", scan_stats["docs_seen"])
+        # Backlog = discovered-but-uncommitted documents; drops back to 0
+        # at the journal commit below. The fleet wedge verdict keys on it.
+        obs.set_gauge("ingest_backlog_docs", len(new_docs))
         carry_rows = _carry_row_count(root, journal)
         if not new_docs and not (flush_tail and carry_rows):
+            obs.fleet.record("ingest.scan", docs_seen=scan_stats["docs_seen"],
+                             docs_new=0, noop=True)
             log("ingest: no new documents ({} seen, all journaled)".format(
                 scan_stats["docs_seen"]))
             return {"noop": True, "generation": journal.generation,
@@ -281,6 +292,9 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
         }
         journal_mod.publish_record(
             journal_mod.intake_path(root, generation), intake)
+        obs.fleet.record("generation.intake", generation=generation,
+                         docs=len(intake["hashes"]),
+                         doc_bytes=intake["doc_bytes"], resumed=False)
         log("ingest: generation {}: {} new document(s) of {} seen".format(
             generation, scan_stats["docs_new"], scan_stats["docs_seen"]))
 
@@ -311,6 +325,8 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
                 emit_manifest=False,
             )
         part_paths = get_all_parquets_under(pre_dir)
+        obs.fleet.record("generation.preprocess", generation=generation,
+                         shards=len(part_paths))
 
     stage_dir = os.path.join(wdir, "balance")
     plan = delta_mod.read_plan(stage_dir)
@@ -329,6 +345,9 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
     published = delta_mod.publish_delta_balance(
         root, stage_dir, plan, carry_dir=journal_mod.carry_dir(root),
         log=log)
+    obs.fleet.record("generation.delta_balance", generation=generation,
+                     new_shards=len(published["new"]),
+                     touched_prior=len(published["touched"]))
 
     changed_dirs = {os.path.dirname(os.path.join(root, rel))
                     for rel in list(published["new"])
@@ -337,10 +356,14 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
     known_counts.update(published["touched"])
     _refresh_dir_bookkeeping(root, changed_dirs or {root}, generation,
                              known_counts)
+    obs.fleet.record("generation.gate_advance", generation=generation)
 
     journal.publish_generation(generation, intake["hashes"], fingerprint,
                                carry=published["carry"],
                                doc_bytes=intake.get("doc_bytes", 0))
+    obs.fleet.record("generation.committed", generation=generation,
+                     docs=len(intake["hashes"]))
+    obs.set_gauge("ingest_backlog_docs", 0)
 
     # Post-commit sweep (idempotent; redone by pending_work on a crash):
     # consumed carry inputs, then the whole work dir.

@@ -25,11 +25,13 @@ Quick tour::
     obs.write_summary()          # summary-*.json + trace flush
 """
 
+from . import fleet
 from .exporters import (
     configure,
     disable,
     export_jsonl,
     export_prom,
+    install_signal_flush,
     start_periodic_export,
     stop_periodic_export,
     summary,
@@ -60,7 +62,9 @@ __all__ = [
     "event",
     "export_jsonl",
     "export_prom",
+    "fleet",
     "flush",
+    "install_signal_flush",
     "inc",
     "metrics_dir",
     "observe",

@@ -208,6 +208,66 @@ def _export_once():
     tracing.flush()
 
 
+_signal_installed = []
+
+
+def install_signal_flush():
+    """Flush telemetry from a SIGTERM handler (idempotent; main thread
+    only — installing elsewhere raises ValueError and is skipped).
+
+    atexit covers normal interpreter exit, but a polite kill (k8s pod
+    eviction, timeout(1), a supervisor's TERM before KILL) used to drop
+    every buffered trace event, unexported counter, and fleet lifecycle
+    event recorded since the last flush — precisely the telemetry an
+    operator needs to diagnose WHY the process was killed. The handler
+    chains any previously-installed Python handler; when the prior
+    disposition was the default (terminate), it re-raises SIGTERM after
+    flushing so the process still dies with the conventional -TERM
+    status; a prior SIG_IGN (or an unknown C-level handler, getsignal()
+    -> None) is preserved — flush only, never turn an ignored signal
+    into a death. The telemetry locks on the flush path are reentrant
+    (see tracing._lock), so a TERM landing while the interrupted frame
+    holds one cannot deadlock the dying process."""
+    if _signal_installed:
+        return
+    import signal
+
+    try:
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _handler(signum, frame):
+            try:
+                final_flush(reason="sigterm")
+            except Exception:  # noqa: BLE001 - dying anyway
+                pass
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+            # SIG_IGN / None (C-level handler we cannot re-invoke):
+            # keep the process alive, exactly as before installation.
+
+        signal.signal(signal.SIGTERM, _handler)
+        _signal_installed.append(True)
+    except ValueError:
+        # Not the main thread: the atexit path still covers clean exits.
+        return
+
+
+def final_flush(reason=None):
+    """One last telemetry publish: registry snapshot exports, trace
+    buffer flush, and the fleet spool (snapshot marked closed). Shared by
+    the atexit and SIGTERM paths; safe to call repeatedly."""
+    if metrics_dir() is not None:
+        export_jsonl()
+        export_prom()
+        tracing.flush()
+    from . import fleet
+    if fleet.enabled():
+        fleet.heartbeat(closed=True, reason=reason or "atexit")
+
+
 def start_periodic_export(interval_s=None):
     """Start the daemon exporter thread (idempotent). Interval defaults to
     ``LDDL_TPU_METRICS_INTERVAL_S`` (30s)."""

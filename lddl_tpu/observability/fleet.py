@@ -1,0 +1,786 @@
+"""Fleet telemetry: cross-host spools + aggregation over the shared FS.
+
+PR 2's observability is strictly per-process: one registry, one trace
+file per rank+pid, all under one host's metrics dir. The elastic
+work-stealing preprocess and the streaming-ingest service run as N
+independent host processes sharing nothing but the output directory —
+so this module extends telemetry to the same deployment assumption the
+lease protocol uses: **no RPC, no daemons, just files on the shared
+filesystem**.
+
+Publisher side (each host, armed via ``LDDL_TPU_FLEET_DIR``):
+
+    <fleet_dir>/.telemetry/<holder>/
+        snapshot-pid<p>.json    latest registry snapshot + clock pair +
+                                liveness flag, atomically republished
+                                every heartbeat (resilience.io path)
+        events-pid<p>.jsonl     append-only structured event log: unit
+                                lifecycle (claimed -> renewed -> stolen/
+                                fenced -> journaled) and generation
+                                lifecycle (intake -> preprocess ->
+                                delta-balance -> gate-advance ->
+                                committed); every record carries a
+                                (wall, mono) clock pair
+        metrics-*.jsonl / trace-*.jsonl / ...
+                                the PR 2 per-process exports, colocated
+                                when ``configure()`` arms the metrics
+                                dir into the spool
+
+Events buffer in memory and flush on the heartbeat interval AND from the
+atexit/SIGTERM handlers (exporters.install_signal_flush), so a dying
+host leaves a parseable tail; a SIGKILLed host may leave one torn final
+line, which every reader here treats as end-of-stream with a warning —
+mirroring torn-lease handling (resilience.leases.read_lease). The
+injector's ``kill`` fault flushes the fleet spool pre-kill for the same
+reason it flushes metrics: a crash the telemetry exists to expose must
+not also destroy the telemetry.
+
+Aggregator side (``aggregate()`` / ``merge_traces()``, consumed by
+``tools/pipeline_status.py`` and ``tools/trace_summary.py --merge``):
+merges all host spools into cluster rollups (units/s and MB/s per host
+and total, steal/fence/retry/quarantine counts, heartbeat ages, ingest
+backlog and generation lag, padding efficiency) and renders health
+verdicts — a host is **stalled** when its heartbeat age exceeds the
+stall TTL without a clean-shutdown marker, the service is **wedged**
+when live hosts exist but the journal/ledger shows no progress inside
+the wedge window. ``merge_traces`` re-bases every host's Chrome-trace
+events through its published (wall, mono) clock samples — a wall-clock
+step mid-run is detected as an offset jump and corrected back onto the
+host's monotonic timeline — and assigns per-host Perfetto lanes, so one
+merged trace spans the whole fleet.
+
+Inertness contract (same as registry.py/tracing.py): disabled, every
+hook is one env-dict lookup; enabled, nothing here raises into the
+pipeline, touches an RNG stream, or writes outside ``.telemetry/``.
+Wall-clock reads are confined to this module (observability is
+allowlisted for them), so the status CLI in tools/ stays clock-free.
+"""
+
+import json
+import logging
+import os
+import re
+import socket
+import threading
+import time
+
+from . import tracing
+from .registry import ENV_DIR as ENV_METRICS_DIR
+from .registry import metrics_dir, rank, registry
+
+ENV_FLEET_DIR = "LDDL_TPU_FLEET_DIR"
+ENV_HOLDER = "LDDL_TPU_FLEET_HOLDER"
+ENV_INTERVAL = "LDDL_TPU_FLEET_INTERVAL_S"
+ENV_TTL = "LDDL_TPU_FLEET_TTL_S"
+
+TELEMETRY_DIR = ".telemetry"
+DEFAULT_INTERVAL_S = 10.0
+DEFAULT_TTL_S = 30.0
+
+# A (wall - mono) offset drifting more than this from its first sample is
+# a wall-clock STEP (NTP slew stays far under it); merge_traces re-anchors
+# later events onto the host's monotonic timeline.
+CLOCK_STEP_S = 0.5
+
+# Event kinds that constitute pipeline PROGRESS for the wedge verdict
+# (scheduling chatter like renewals deliberately does not count).
+PROGRESS_EVENTS = frozenset({
+    "unit.journaled", "generation.committed", "generation.gate_advance",
+    "generation.pickup",
+})
+
+_MAX_BUFFER = 50000  # hard cap, like tracing: runaway loops must not OOM
+
+_log = logging.getLogger("lddl_tpu.observability.fleet")
+
+_SAFE_RE = re.compile(r"[^A-Za-z0-9_.-]+")
+
+# RLock for the same reason as tracing._lock: the SIGTERM flush handler
+# may interrupt a frame holding this lock on the main thread, and must
+# re-enter rather than deadlock the dying process.
+_lock = threading.RLock()
+_events = []
+_started = []          # [True] once the heartbeat/exit hooks are live
+_hb = {"thread": None, "stop": None}
+_cached = {"raw": object(), "dir": None}
+_started_wall = time.time()
+
+
+# ------------------------------------------------------------- enablement
+
+
+def fleet_dir():
+    """The fleet root (spools live under ``<dir>/.telemetry/``), or None
+    when fleet telemetry is disabled. One env lookup on the cached path."""
+    raw = os.environ.get(ENV_FLEET_DIR)
+    if raw != _cached["raw"]:
+        with _lock:
+            _cached["raw"] = raw
+            _cached["dir"] = raw or None
+    return _cached["dir"]
+
+
+def enabled():
+    return fleet_dir() is not None
+
+
+def sanitize_holder(holder):
+    safe = _SAFE_RE.sub("-", str(holder)).strip("-")
+    return safe or "host"
+
+
+def holder():
+    """This process's spool name: the env-pinned holder (inherited by
+    worker processes) or a per-process hostname-pid default."""
+    h = os.environ.get(ENV_HOLDER)
+    if h:
+        return sanitize_holder(h)
+    return sanitize_holder("{}-pid{}".format(socket.gethostname(),
+                                             os.getpid()))
+
+
+def spool_dir(root=None, for_holder=None):
+    root = root if root is not None else fleet_dir()
+    if root is None:
+        return None
+    return os.path.join(root, TELEMETRY_DIR, for_holder or holder())
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def configure(dir, holder_id=None, ttl=None, interval=None,  # noqa: A002
+              arm_metrics=True):
+    """Arm fleet telemetry in this process AND future children (env vars
+    are the source of truth, like registry.configure). Pins the holder
+    into the env so spawned pool/loader workers publish into the SAME
+    spool (per-pid files never contend). ``arm_metrics=True`` (default)
+    also points ``LDDL_TPU_METRICS_DIR`` at the spool when metrics are
+    not armed elsewhere, colocating the PR 2 per-process exports with the
+    fleet spool — which is what lets the aggregator compute counter
+    rollups and merge traces for hosts that died mid-run."""
+    os.environ[ENV_FLEET_DIR] = dir
+    os.environ[ENV_HOLDER] = sanitize_holder(holder_id) if holder_id \
+        else holder()
+    if ttl is not None:
+        os.environ[ENV_TTL] = str(float(ttl))
+    if interval is not None:
+        os.environ[ENV_INTERVAL] = str(float(interval))
+    spool = spool_dir()
+    if arm_metrics and metrics_dir() is None:
+        os.environ[ENV_METRICS_DIR] = spool
+    ensure_started()
+    return spool
+
+
+def adopt_holder(holder_id, ttl=None):
+    """Pin ``holder_id`` as this process tree's spool name if the env has
+    not already chosen one (the elastic runner calls this so spool names
+    match lease-file holder ids — 'which host is stalled' and 'who stole
+    unit 7' then name the same thing), and advertise ``ttl`` as the stall
+    threshold hint when none was configured (a heartbeat older than the
+    lease TTL is exactly when survivors may steal the host's units). A
+    no-op when fleet is disabled."""
+    if not enabled():
+        return
+    if not os.environ.get(ENV_HOLDER):
+        os.environ[ENV_HOLDER] = sanitize_holder(holder_id)
+    if ttl is not None and not os.environ.get(ENV_TTL):
+        os.environ[ENV_TTL] = str(float(ttl))
+    ensure_started()
+
+
+# ------------------------------------------------------------- publishing
+
+
+def record(kind, **fields):
+    """Append one lifecycle event to the in-memory buffer (flushed on the
+    heartbeat and at exit). A no-op costing one env lookup when disabled;
+    enabled, it never raises into the caller."""
+    if fleet_dir() is None:
+        return
+    try:
+        ev = {"kind": str(kind), "wall": time.time(),
+              "mono": time.monotonic(), "pid": os.getpid()}
+        if fields:
+            ev["args"] = {k: _jsonable(v) for k, v in fields.items()}
+        with _lock:
+            if len(_events) >= _MAX_BUFFER:
+                return
+            _events.append(ev)
+        ensure_started()
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def _events_path():
+    d = spool_dir()
+    if d is None:
+        return None
+    return os.path.join(d, "events-pid{}.jsonl".format(os.getpid()))
+
+
+def _snapshot_path():
+    d = spool_dir()
+    if d is None:
+        return None
+    return os.path.join(d, "snapshot-pid{}.json".format(os.getpid()))
+
+
+def flush_events():
+    """Append buffered events to this process's spool event log. Each
+    line is written complete; only a mid-write crash can tear the final
+    line, which readers degrade to end-of-stream."""
+    path = _events_path()
+    with _lock:
+        if not _events:
+            return path
+        batch, _events[:] = list(_events), []
+    if path is None:
+        return None
+    try:
+        from ..resilience import io as rio
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        payload = "".join(json.dumps(ev, sort_keys=True) + "\n"
+                          for ev in batch)
+        with rio.open_append(path) as f:
+            f.write(payload.encode("utf-8"))
+    except Exception:  # noqa: BLE001 - drop the batch, never the pipeline
+        pass
+    return path
+
+
+def publish_snapshot(closed=False, reason=None):
+    """Atomically (re)publish this process's registry snapshot + clock
+    pair + liveness flag, via the resilience.io publish path — the same
+    tmp+fsync+replace dance shards ride, so a reader never sees a torn
+    snapshot. ``closed=True`` marks a clean shutdown: the aggregator only
+    stall-flags hosts that went silent WITHOUT it."""
+    path = _snapshot_path()
+    if path is None:
+        return None
+    try:
+        from ..resilience import io as rio
+        snap = {
+            "holder": holder(),
+            "pid": os.getpid(),
+            "rank": rank(),
+            "hostname": socket.gethostname(),
+            "wall": time.time(),
+            "mono": time.monotonic(),
+            "started_wall": _started_wall,
+            "interval_s": _env_float(ENV_INTERVAL, DEFAULT_INTERVAL_S),
+            "ttl_s": _env_float(ENV_TTL, DEFAULT_TTL_S),
+            "closed": bool(closed),
+            "metrics": registry().snapshot(),
+        }
+        if reason:
+            snap["closed_reason"] = str(reason)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        rio.atomic_write(path, json.dumps(snap, sort_keys=True, default=str))
+    except Exception:  # noqa: BLE001 - drop the export, never the pipeline
+        return None
+    return path
+
+
+def heartbeat(closed=False, reason=None):
+    """One publish cycle: event-log flush + snapshot republish (+ the
+    colocated PR 2 exports when the metrics dir lives in the spool).
+    Called by the heartbeat thread, the exit hooks, and the fault
+    injector's pre-kill flush."""
+    if not enabled():
+        return None
+    flush_events()
+    path = publish_snapshot(closed=closed, reason=reason)
+    try:
+        tracing.flush()
+        d = metrics_dir()
+        if d is not None and os.path.abspath(d) == os.path.abspath(
+                spool_dir() or d):
+            from . import exporters
+            exporters.export_jsonl()
+    except Exception:  # noqa: BLE001 - best-effort colocated exports
+        pass
+    return path
+
+
+def ensure_started(interval=None):
+    """Start the heartbeat thread + exit hooks once (idempotent, no-op
+    when disabled). Every ``record()`` calls this, so arming the env var
+    is the only configuration a host needs — including the metrics side:
+    if no metrics dir is armed, one is pointed at the spool here, so an
+    env-only arming (documented as equivalent to ``--fleet-telemetry``)
+    still publishes non-empty registry snapshots instead of silently
+    reporting every counter as zero."""
+    if not enabled() or _started:
+        return
+    with _lock:
+        if _started:
+            return
+        _started.append(True)
+    if metrics_dir() is None:
+        spool = spool_dir()
+        if spool is not None:
+            os.environ[ENV_METRICS_DIR] = spool
+    import atexit
+    atexit.register(_final_flush)
+    from . import exporters
+    exporters.install_signal_flush()
+    if interval is None:
+        interval = _env_float(ENV_INTERVAL, DEFAULT_INTERVAL_S)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(interval):
+            if not enabled():
+                return
+            try:
+                heartbeat()
+            except Exception:  # noqa: BLE001 - keep beating
+                pass
+
+    t = threading.Thread(target=loop, name="lddl-fleet-heartbeat",
+                         daemon=True)
+    t.start()
+    _hb["thread"] = t
+    _hb["stop"] = stop
+
+
+def _final_flush():
+    try:
+        heartbeat(closed=True, reason="atexit")
+    except Exception:  # noqa: BLE001 - exiting anyway
+        pass
+
+
+def _reset_for_tests():
+    with _lock:
+        _events[:] = []
+        _started[:] = []
+    if _hb["stop"] is not None:
+        _hb["stop"].set()
+    _hb["thread"] = None
+    _hb["stop"] = None
+
+
+# ------------------------------------------------------------ spool reads
+
+
+def read_jsonl(path, warn=None):
+    """All parseable records of one spool JSONL file, torn-tolerant:
+    a torn TRAILING line (a writer died mid-append) reads as end-of-
+    stream with a warning; a torn interior line (storage misbehaviour)
+    is skipped with a warning. Never raises on content. Streams line by
+    line (long-running hosts grow spools without bound — never hold the
+    whole file), with one unparsed line of lookahead to tell trailing
+    from interior. Returns ``(records, torn_line_count)``."""
+    warn = warn or _log.warning
+    records, torn = [], 0
+    pending = None  # line number of the last unparsed line, pending EOF
+    try:
+        with open(path, "rb") as f:
+            for i, line in enumerate(f):
+                line = line.strip()
+                if not line:
+                    continue
+                if pending is not None:
+                    warn("torn interior line %d in %s; skipping",
+                         pending + 1, path)
+                    pending = None
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    torn += 1
+                    pending = i
+                    continue
+                if isinstance(rec, dict):
+                    records.append(rec)
+    except OSError as e:
+        warn("unreadable telemetry file %s (%s); skipping", path, e)
+        return [], 0
+    if pending is not None:
+        warn("torn trailing line in %s (writer died mid-append?); "
+             "treating as end-of-stream", path)
+    return records, torn
+
+
+def _read_json(path, warn=None):
+    warn = warn or _log.warning
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        warn("unreadable telemetry file %s (%s); skipping", path, e)
+        return None
+    try:
+        rec = json.loads(raw)
+    except ValueError:
+        warn("torn telemetry snapshot %s; skipping", path)
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def telemetry_root(root):
+    return os.path.join(root, TELEMETRY_DIR)
+
+
+def list_holders(root):
+    d = telemetry_root(root)
+    if not os.path.isdir(d):
+        return []
+    return [n for n in sorted(os.listdir(d))
+            if os.path.isdir(os.path.join(d, n))]
+
+
+def load_spool(root, holder_name, warn=None):
+    """One holder's spool, parsed: latest snapshot per pid, the full
+    event stream (wall-ordered), and torn-line accounting."""
+    d = spool_dir(root, holder_name)
+    snapshots, events, torn = {}, [], 0
+    for name in sorted(os.listdir(d)) if os.path.isdir(d) else []:
+        path = os.path.join(d, name)
+        if name.startswith("snapshot-pid") and name.endswith(".json"):
+            snap = _read_json(path, warn)
+            if snap is not None:
+                snapshots[int(snap.get("pid", 0))] = snap
+        elif name.startswith("events-pid") and name.endswith(".jsonl"):
+            recs, t = read_jsonl(path, warn)
+            events.extend(recs)
+            torn += t
+    events.sort(key=lambda ev: ev.get("wall", 0.0))
+    return {"holder": holder_name, "dir": d, "snapshots": snapshots,
+            "events": events, "torn_lines": torn}
+
+
+# ------------------------------------------------------------- aggregator
+
+# Registry counters the rollup surfaces per host and in the totals row
+# (report key -> metric name; counts are summed over the holder's pids).
+ROLLUP_COUNTERS = (
+    ("units_completed", "elastic_units_completed_total"),
+    ("steals", "lease_steals_total"),
+    ("fence_rejects", "lease_fence_rejects_total"),
+    ("renews", "lease_renews_total"),
+    ("retries", "resilience_retry_attempts_total"),
+    ("retry_exhausted", "resilience_retry_exhausted_total"),
+    ("faults_injected", "resilience_faults_injected_total"),
+    ("quarantined_shards", "resilience_quarantined_shards_total"),
+    ("docs", "preprocess_docs_total"),
+    ("doc_bytes", "preprocess_doc_bytes_total"),
+    ("samples", "preprocess_samples_total"),
+    ("ingest_docs", "ingest_docs_total"),
+    ("generations_published", "ingest_generations_published_total"),
+    ("loader_batches", "loader_batches_total"),
+)
+
+# Gauges reported at host level when present (latest snapshot wins).
+ROLLUP_GAUGES = (
+    ("padding_efficiency", "loader_padding_efficiency"),
+    ("generation_lag", "loader_generation_lag"),
+    ("generations_loaded", "loader_generations_loaded"),
+    ("ingest_generation", "ingest_generation"),
+    ("ingest_backlog_docs", "ingest_backlog_docs"),
+    ("ingest_carry_rows", "ingest_carry_rows"),
+    ("samples_per_second", "preprocess_samples_per_second"),
+)
+
+
+def _counter_total(snap_metrics, name):
+    data = (snap_metrics or {}).get(name)
+    if not data or data.get("type") != "counter":
+        return 0
+    return sum(data.get("values", {}).values())
+
+
+def _gauge_value(snap_metrics, name):
+    data = (snap_metrics or {}).get(name)
+    if not data or data.get("type") != "gauge":
+        return None
+    values = data.get("values", {})
+    if not values:
+        return None
+    # Unlabelled gauge is the common case; otherwise take the max label.
+    return values.get("", max(values.values()))
+
+
+def _host_rollup(spool, now, stall_ttl):
+    snaps = list(spool["snapshots"].values())
+    counters = {key: sum(_counter_total(s.get("metrics"), metric)
+                         for s in snaps)
+                for key, metric in ROLLUP_COUNTERS}
+    gauges = {}
+    for key, metric in ROLLUP_GAUGES:
+        vals = [v for v in (_gauge_value(s.get("metrics"), metric)
+                            for s in snaps) if v is not None]
+        if vals:
+            gauges[key] = max(vals)
+    stamps = [s.get("wall", 0.0) for s in snaps]
+    stamps.extend(ev.get("wall", 0.0) for ev in spool["events"][-1:])
+    last_wall = max(stamps) if stamps else None
+    started = min((s.get("started_wall", s.get("wall", now))
+                   for s in snaps), default=None)
+    ttl = max((s.get("ttl_s", DEFAULT_TTL_S) for s in snaps),
+              default=DEFAULT_TTL_S)
+    if stall_ttl is not None:
+        ttl = stall_ttl
+    closed = bool(snaps) and all(s.get("closed") for s in snaps)
+    age = (now - last_wall) if last_wall is not None else None
+    elapsed = None
+    if last_wall is not None and started is not None \
+            and last_wall > started:
+        elapsed = last_wall - started
+    rates = {}
+    if elapsed:
+        rates["units_per_s"] = counters["units_completed"] / elapsed
+        rates["mb_per_s"] = counters["doc_bytes"] / 1e6 / elapsed
+        rates["samples_per_s"] = counters["samples"] / elapsed
+    event_counts = {}
+    for ev in spool["events"]:
+        k = ev.get("kind", "?")
+        event_counts[k] = event_counts.get(k, 0) + 1
+    progress = [ev.get("wall", 0.0) for ev in spool["events"]
+                if ev.get("kind") in PROGRESS_EVENTS]
+    return {
+        "holder": spool["holder"],
+        "pids": sorted(spool["snapshots"]),
+        "started_wall": started,
+        "last_heartbeat_wall": last_wall,
+        "heartbeat_age_s": age,
+        "closed": closed,
+        "stall_ttl_s": ttl,
+        "stalled": (not closed and age is not None and age > ttl),
+        "counters": counters,
+        "gauges": gauges,
+        "rates": rates,
+        "events_total": len(spool["events"]),
+        "event_counts": event_counts,
+        "torn_lines": spool["torn_lines"],
+        "last_progress_wall": max(progress) if progress else None,
+    }
+
+
+def _fs_progress_stamps(root):
+    """Latest mtimes of the on-disk ground truth the wedge verdict also
+    trusts: preprocess ledger records and ingest journal segments. File
+    mtimes come from the shared FS's clock — same budget the lease
+    deadlines already live on."""
+    stamps = []
+    for d in (os.path.join(root, "_done"),
+              os.path.join(root, ".ingest", "journal")):
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            try:
+                stamps.append(os.stat(os.path.join(d, name)).st_mtime)
+            except OSError:
+                continue
+    return stamps
+
+
+def _pending_work(root, hosts):
+    """Evidence that the pipeline has UNFINISHED work — the wedge verdict
+    requires it (an idle-but-alive watch service with nothing to ingest
+    is healthy, not wedged): a nonzero ingest backlog gauge on any host,
+    an in-flight ingest generation (work dir present), or a preprocess
+    run mid-flight (unretired unit ledger)."""
+    for st in hosts.values():
+        if st["gauges"].get("ingest_backlog_docs"):
+            return "ingest backlog"
+    wdir = os.path.join(root, ".ingest", "work")
+    if os.path.isdir(wdir) and sorted(os.listdir(wdir)):
+        return "in-flight ingest generation"
+    if os.path.isdir(os.path.join(root, "_done")):
+        return "unretired preprocess ledger"
+    return None
+
+
+def _journal_state(root):
+    """The ingest journal's latest generation, read off the segment file
+    names (cheap, no segment parse)."""
+    d = os.path.join(root, ".ingest", "journal")
+    if not os.path.isdir(d):
+        return None
+    gens = []
+    for name in sorted(os.listdir(d)):
+        m = re.match(r"gen-(\d+)\.json$", name)
+        if m:
+            gens.append(int(m.group(1)))
+    return max(gens) if gens else None
+
+
+def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
+    """Merge every host spool under ``<root>/.telemetry/`` into one
+    cluster report with health verdicts. Pure function of the spool
+    bytes, ``now`` (defaults to this process's wall clock — the one
+    clock read the status CLI delegates here) and the two thresholds."""
+    now = time.time() if now is None else float(now)
+    hosts = {}
+    for h in list_holders(root):
+        hosts[h] = _host_rollup(load_spool(root, h, warn), now, stall_ttl)
+    totals = {key: sum(h["counters"][key] for h in hosts.values())
+              for key, _ in ROLLUP_COUNTERS}
+    total_rates = {}
+    for key in ("units_per_s", "mb_per_s", "samples_per_s"):
+        vals = [h["rates"].get(key) for h in hosts.values()
+                if h["rates"].get(key) is not None]
+        if vals:
+            total_rates[key] = sum(vals)
+    stalled = sorted(h for h, st in hosts.items() if st["stalled"])
+    live = sorted(h for h, st in hosts.items()
+                  if not st["closed"] and not st["stalled"])
+    progress = [st["last_progress_wall"] for st in hosts.values()
+                if st["last_progress_wall"] is not None]
+    progress.extend(_fs_progress_stamps(root))
+    last_progress = max(progress) if progress else None
+    ttl = stall_ttl if stall_ttl is not None else max(
+        (st["stall_ttl_s"] for st in hosts.values()), default=DEFAULT_TTL_S)
+    window = wedge_window if wedge_window is not None \
+        else max(4.0 * ttl, 120.0)
+    pending = _pending_work(root, hosts)
+    # "No progress EVER" must not instant-wedge a freshly started run
+    # (the first generation/unit legitimately takes a while to land):
+    # the baseline the window counts from is the last progress stamp, or
+    # the earliest host start when none exists yet.
+    started = [st["started_wall"] for st in hosts.values()
+               if st["started_wall"] is not None]
+    baseline = last_progress if last_progress is not None \
+        else (min(started) if started else None)
+    wedged = bool(live) and pending is not None and (
+        baseline is not None and (now - baseline) > window)
+    verdicts = []
+    for h in stalled:
+        verdicts.append(
+            "host {} STALLED: last heartbeat {:.1f}s ago exceeds the "
+            "{:.1f}s stall TTL with no clean-shutdown marker".format(
+                h, hosts[h]["heartbeat_age_s"], hosts[h]["stall_ttl_s"]))
+    if wedged:
+        age = "never" if last_progress is None \
+            else "{:.1f}s ago".format(now - last_progress)
+        verdicts.append(
+            "service WEDGED: {} live host(s) with {} but last "
+            "journal/ledger progress was {} (window {:.1f}s)".format(
+                len(live), pending, age, window))
+    for h, st in sorted(hosts.items()):
+        if st["torn_lines"]:
+            verdicts.append(
+                "host {}: {} torn spool line(s) tolerated (host died "
+                "mid-append?)".format(h, st["torn_lines"]))
+    return {
+        "root": os.path.abspath(root),
+        "generated_wall": now,
+        "hosts": hosts,
+        "totals": {"counters": totals, "rates": total_rates},
+        "journal_generation": _journal_state(root),
+        "pending_work": pending,
+        "last_progress_wall": last_progress,
+        "health": {
+            "ok": not stalled and not wedged,
+            "stalled_hosts": stalled,
+            "live_hosts": live,
+            "closed_hosts": sorted(h for h, st in hosts.items()
+                                   if st["closed"]),
+            "wedged": wedged,
+            "stall_ttl_s": ttl,
+            "wedge_window_s": window,
+            "verdicts": verdicts,
+        },
+    }
+
+
+# ------------------------------------------------------------ trace merge
+
+
+def _clock_samples(spool):
+    """Per-pid (wall, wall-mono) samples from every spool record that
+    carries the clock pair, mono-ordered."""
+    by_pid = {}
+    for ev in spool["events"]:
+        if "wall" in ev and "mono" in ev:
+            by_pid.setdefault(int(ev.get("pid", 0)), []).append(
+                (float(ev["mono"]), float(ev["wall"])))
+    for pid, snap in spool["snapshots"].items():
+        if "wall" in snap and "mono" in snap:
+            by_pid.setdefault(int(pid), []).append(
+                (float(snap["mono"]), float(snap["wall"])))
+    return {pid: sorted(samples) for pid, samples in by_pid.items()}
+
+
+def _step_corrections(samples):
+    """Wall-clock-step corrections for one pid: segments of
+    ``(wall_from, delta_s)`` meaning events stamped at/after ``wall_from``
+    were recorded ``delta_s`` off the process's original wall<->mono
+    anchor and must be shifted back by ``delta_s``. Empty when the clock
+    behaved (the overwhelmingly common case)."""
+    if len(samples) < 2:
+        return []
+    base = samples[0][1] - samples[0][0]  # first wall - mono offset
+    segments = []
+    current = 0.0
+    for mono, wall in samples[1:]:
+        delta = (wall - mono) - base
+        if abs(delta - current) > CLOCK_STEP_S:
+            segments.append((wall, delta))
+            current = delta
+    return segments
+
+
+def _corrected_ts(ts_us, segments):
+    delta = 0.0
+    for wall_from, d in segments:
+        if ts_us >= wall_from * 1e6:
+            delta = d
+    return ts_us - delta * 1e6
+
+
+def merge_traces(root, warn=None):
+    """Merge every host spool's Chrome-trace files into ONE event list
+    spanning the fleet: per-(holder, pid) Perfetto lanes (synthetic lane
+    pids with ``process_name``/``process_sort_index`` metadata naming the
+    real holder+pid), and per-pid wall-clock-step correction from the
+    spool's clock samples so a stepped host still lines up. Returns
+    ``(events, lanes)`` where lanes is ``[(lane_pid, holder, real_pid)]``;
+    the caller writes the JSON (Perfetto accepts a plain JSON array)."""
+    events, lanes = [], []
+    lane_of = {}
+    for h in list_holders(root):
+        spool = load_spool(root, h, warn)
+        corrections = {pid: _step_corrections(samples)
+                       for pid, samples in _clock_samples(spool).items()}
+        d = spool["dir"]
+        names = [n for n in sorted(os.listdir(d))
+                 if n.startswith("trace-") and n.endswith(".jsonl")] \
+            if os.path.isdir(d) else []
+        for name in names:
+            recs, _ = read_jsonl(os.path.join(d, name), warn)
+            for rec in recs:
+                if rec.get("ph") == "M":
+                    continue  # re-emitted per lane below
+                real_pid = int(rec.get("pid", 0))
+                key = (h, real_pid)
+                if key not in lane_of:
+                    lane_of[key] = len(lane_of) + 1
+                    lanes.append((lane_of[key], h, real_pid))
+                out = dict(rec)
+                out["pid"] = lane_of[key]
+                segs = corrections.get(real_pid)
+                if segs and "ts" in out:
+                    out["ts"] = _corrected_ts(float(out["ts"]), segs)
+                events.append(out)
+    meta = []
+    for lane, h, real_pid in lanes:
+        meta.append({"name": "process_name", "ph": "M", "pid": lane,
+                     "args": {"name": "{} pid{}".format(h, real_pid)}})
+        meta.append({"name": "process_sort_index", "ph": "M", "pid": lane,
+                     "args": {"sort_index": lane}})
+    events.sort(key=lambda ev: ev.get("ts", 0.0))
+    return meta + events, lanes

@@ -207,14 +207,20 @@ def _ensure_final_export():
         try:
             if metrics_dir() is None:
                 return
-            from . import exporters, tracing
-            exporters.export_jsonl()
-            exporters.export_prom()
-            tracing.flush()
+            from . import exporters
+            exporters.final_flush()
         except Exception:  # noqa: BLE001 - telemetry must stay inert
             pass
 
     atexit.register(_final_export)
+    try:
+        from . import exporters
+        # SIGTERM must flush the same set atexit does (see
+        # exporters.install_signal_flush); a polite kill used to lose
+        # everything since the last periodic export.
+        exporters.install_signal_flush()
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass
 
 
 class Registry:

@@ -25,7 +25,13 @@ import time
 
 from .registry import metrics_dir, rank
 
-_lock = threading.Lock()
+# RLock, not Lock: the SIGTERM flush handler (exporters.install_signal_
+# flush) runs on the main thread between bytecodes and may interrupt a
+# frame that already holds this lock mid-_push; a non-reentrant lock
+# would deadlock the dying process instead of flushing it. Re-entry is
+# safe: flush() only swaps the buffer out, and the interrupted append
+# lands in the fresh buffer.
+_lock = threading.RLock()
 _buffer = []          # pending trace event dicts
 _emitted_meta = set()  # pids that already wrote their process_name event
 _MAX_BUFFER = 50000    # hard cap: a runaway loop must not eat the heap

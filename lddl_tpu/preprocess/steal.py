@@ -391,6 +391,8 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
         obs.inc("lease_fence_rejects_total")
         obs.event("lease.fence_reject", unit="{}{}".format(
             unit_prefix, unit), epoch=lease.epoch)
+        obs.fleet.record("unit.fenced", unit="{}{}".format(
+            unit_prefix, unit), epoch=lease.epoch, holder=holder, why=why)
         log("{}: unit {} {} at epoch {}; late result discarded "
             "(fence)".format(phase, unit, why, lease.epoch))
 
@@ -421,6 +423,9 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
                 return
             leases.release(lease)
             failed[unit] = "{}: {}".format(type(e).__name__, e)
+            obs.fleet.record("unit.failed", unit="{}{}".format(
+                unit_prefix, unit), epoch=lease.epoch, holder=holder,
+                error=failed[unit][:200])
             remaining.discard(unit)
             log("{}: unit {} failed ({}); lease released for another "
                 "host".format(phase, unit, failed[unit]))
@@ -440,6 +445,9 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
         # Label = the phase word ("scatter"/"gather"/"process"), not the
         # constant "elastic" prefix of the display name.
         obs.inc("elastic_units_completed_total", phase=phase.split()[-1])
+        obs.fleet.record("unit.journaled", unit="{}{}".format(
+            unit_prefix, unit), epoch=lease.epoch, holder=holder,
+            phase=phase.split()[-1])
         remaining.discard(unit)
         progress.tick(sum(result.values())
                       if isinstance(result, dict) else 0)
@@ -707,6 +715,11 @@ def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
         raise ValueError("lease_ttl must be > 0, got {}".format(lease_ttl))
     poll = poll_s if poll_s is not None else max(0.05, min(ttl / 4.0, 2.0))
     keeper = leases.LeaseKeeper(ttl)
+    # Fleet spools (when armed) should carry the LEASE holder name, so
+    # the status report's "host h0 stalled" and the lease events' "stolen
+    # from h0" name the same thing; the env pin makes pool workers
+    # publish into the same spool.
+    obs.fleet.adopt_holder(holder, ttl=ttl)
     log("elastic preprocess: holder={} ttl={}s".format(holder, ttl))
     totals = {"completed": 0, "stolen": 0, "fence_rejects": 0}
 

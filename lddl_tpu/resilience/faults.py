@@ -196,9 +196,13 @@ def fault_point(op, path=None):
             # flush the injection record NOW or the kill is invisible in
             # the telemetry it exists to make visible.
             try:
-                from ..observability import exporters, tracing
+                from ..observability import exporters, fleet, tracing
                 tracing.flush()
                 exporters.export_jsonl()
+                # Fleet spool too (snapshot left UN-closed: the host is
+                # dying abnormally, and the aggregator's stall verdict
+                # keys on exactly that).
+                fleet.heartbeat(closed=False)
             except Exception:  # noqa: BLE001 - the kill must still fire
                 pass
             import signal

@@ -32,6 +32,7 @@ import time
 
 from . import faults
 from ..observability import event as obs_event
+from ..observability import fleet
 from ..observability import inc as obs_inc
 
 # OSError errnos considered transient on shared storage: worth retrying.
@@ -104,6 +105,9 @@ def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
             op = desc.split(" ", 1)[0]
             if attempt >= attempts or elapsed >= deadline_s:
                 obs_inc("resilience_retry_exhausted_total", op=op)
+                fleet.record("io.retry_exhausted", op=op,
+                             error="{}: {}".format(type(e).__name__,
+                                                   e)[:200])
                 raise OSError(
                     getattr(e, "errno", None) or errno.EIO,
                     "{} failed after {} attempt(s) over {:.1f}s: {}".format(

@@ -51,6 +51,7 @@ import uuid
 from . import faults
 from . import io as rio
 from ..observability import event as obs_event
+from ..observability import fleet
 from ..observability import inc as obs_inc
 
 LEASE_DIR = "_leases"
@@ -228,6 +229,8 @@ def try_acquire(root, unit, holder, ttl_s, now_fn=time.time):
             got = read_lease(root, unit)
             if _matches(got, holder, 0):
                 obs_inc("lease_acquires_total")
+                fleet.record("unit.claimed", unit=str(unit), epoch=0,
+                             holder=holder)
                 return Lease(root, unit, holder, 0, rec["deadline"])
         obs_inc("lease_acquire_conflicts_total")
         return None
@@ -246,6 +249,8 @@ def try_acquire(root, unit, holder, ttl_s, now_fn=time.time):
         obs_inc("lease_steals_total")
         obs_event("lease.steal", unit=str(unit), epoch=new_epoch,
                   prev_holder=str(cur.get("holder", "")))
+        fleet.record("unit.stolen", unit=str(unit), epoch=new_epoch,
+                     holder=holder, prev_holder=str(cur.get("holder", "")))
         return Lease(root, unit, holder, new_epoch, rec["deadline"])
     obs_inc("lease_acquire_conflicts_total")
     return None
@@ -273,6 +278,8 @@ def renew(lease, ttl_s, now_fn=time.time):
             lease.unit))
     lease.deadline = rec["deadline"]
     obs_inc("lease_renews_total")
+    fleet.record("unit.renewed", unit=str(lease.unit), epoch=lease.epoch,
+                 holder=lease.holder)
     return lease
 
 
@@ -366,6 +373,8 @@ class LeaseKeeper(object):
                 except LeaseLost:
                     obs_event("lease.lost", unit=str(lease.unit),
                               epoch=lease.epoch)
+                    fleet.record("unit.lost", unit=str(lease.unit),
+                                 epoch=lease.epoch, holder=lease.holder)
                     _log.warning("lease for unit %s stolen at epoch %s; "
                                  "in-flight result will be fenced off",
                                  lease.unit, lease.epoch)

@@ -283,6 +283,44 @@ def test_stage_span_present_span_passes():
     assert check(src, "lddl_tpu/balance/balancer.py", ["stage-span"]) == []
 
 
+def test_stage_span_covers_elastic_and_ingest_entry_points():
+    """The elastic claim loop and the streaming-ingest service are stage
+    entry points too: steal.py owes BOTH its gather and finalize spans
+    (one finding per missing name), incremental.py owes ingest.run."""
+    bare = """
+    def run_elastic_pipeline(spec):
+        return claim(spec)
+    """
+    ids = rule_ids(check(bare, "lddl_tpu/preprocess/steal.py",
+                         ["stage-span"]))
+    assert ids == ["stage-span", "stage-span"]
+    partial = """
+    from .. import observability as obs
+
+    def run_elastic_pipeline(spec):
+        with obs.span("preprocess.gather", elastic=True):
+            return claim(spec)
+    """
+    assert len(check(partial, "lddl_tpu/preprocess/steal.py",
+                     ["stage-span"])) == 1  # finalize still missing
+    full = partial + """
+    def _finalize(spec):
+        with obs.span("preprocess.finalize"):
+            return done(spec)
+    """
+    assert check(full, "lddl_tpu/preprocess/steal.py", ["stage-span"]) == []
+    assert rule_ids(check(bare, "lddl_tpu/ingest/incremental.py",
+                          ["stage-span"])) == ["stage-span"]
+    ok = """
+    from .. import observability as obs
+
+    def ingest_once(root):
+        with obs.span("ingest.run", root=root):
+            return body(root)
+    """
+    assert check(ok, "lddl_tpu/ingest/incremental.py", ["stage-span"]) == []
+
+
 def test_jit_host_effect_true_positives():
     src = """
     import functools

@@ -138,7 +138,7 @@ def test_uninterrupted_runs_are_deterministic(fixture_dirs,
 
 # Driver for the elastic claim loop (same plan as _DRIVER, so the SAME
 # reference hashes apply — leases must never change output bytes).
-# argv: corpus vocab out holder ttl
+# argv: corpus vocab out holder ttl [fleet]
 _ELASTIC_DRIVER = """
 import sys
 from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
@@ -146,6 +146,11 @@ from lddl_tpu.preprocess.runner import run_bert_preprocess
 from lddl_tpu import observability as obs
 
 corpus, vocab, out, holder, ttl = sys.argv[1:6]
+if "fleet" in sys.argv[6:]:
+    # The CLI --fleet-telemetry path: spool under <out>/.telemetry/,
+    # metrics armed into the spool, heartbeats on a short interval.
+    obs.fleet.configure(out, holder_id=holder, ttl=float(ttl),
+                        interval=0.5)
 tok = get_tokenizer(vocab_file=vocab)
 cfg = BertPretrainConfig(max_seq_length=32, masking=True)
 run_bert_preprocess(
@@ -157,7 +162,7 @@ obs.write_summary()
 
 
 def _spawn_elastic(corpus, vocab, out, holder, ttl, fault_spec=None,
-                   metrics_dir=None):
+                   metrics_dir=None, fleet=False):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
@@ -169,10 +174,15 @@ def _spawn_elastic(corpus, vocab, out, holder, ttl, fault_spec=None,
         env["LDDL_TPU_METRICS_DIR"] = metrics_dir
     else:
         env.pop("LDDL_TPU_METRICS_DIR", None)
+    for name in ("LDDL_TPU_FLEET_DIR", "LDDL_TPU_FLEET_HOLDER",
+                 "LDDL_TPU_FLEET_TTL_S", "LDDL_TPU_FLEET_INTERVAL_S"):
+        env.pop(name, None)
+    argv = [sys.executable, "-c", _ELASTIC_DRIVER, corpus, vocab, out,
+            holder, str(ttl)]
+    if fleet:
+        argv.append("fleet")
     return subprocess.Popen(
-        [sys.executable, "-c", _ELASTIC_DRIVER, corpus, vocab, out, holder,
-         str(ttl)],
-        env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+        argv, env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
 
 
@@ -208,18 +218,26 @@ def _counter_total(metrics_dir, name):
 
 def test_elastic_sigkill_one_host_survivors_byte_identical(
         fixture_dirs, reference_hashes, tmp_path):
-    """Three elastic host processes; one is SIGKILLed mid-gather (while
-    holding a unit's lease, before journaling it). The survivors steal
-    and redo its unit, run the lease-guarded finalize, and the merged
-    output — shards AND manifest — is byte-identical to the single-host
-    reference run."""
+    """Three elastic host processes with FLEET TELEMETRY armed; one is
+    SIGKILLed mid-gather (while holding a unit's lease, before journaling
+    it). The survivors steal and redo its unit, run the lease-guarded
+    finalize, and the merged output — shards AND manifest — is
+    byte-identical to the single-host telemetry-off reference run.
+
+    The fleet acceptance pin rides the same run: from the telemetry
+    artifacts alone, `pipeline_status --json` identifies the dead host as
+    stalled, its totals match the run's journaled ground truth (24 units,
+    >=1 steal), and the merged Chrome trace spans all three hosts."""
     td, corpus, vocab = fixture_dirs
     ref_out = str(tmp_path / "ref")
     proc = _run_driver(corpus, vocab, ref_out, resume=False)
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
     out = str(tmp_path / "out")
-    mdirs = {h: str(tmp_path / ("m_" + h)) for h in ("h0", "h1", "h2")}
+    # Per-host metrics land in the fleet spools (fleet=True arms the
+    # metrics dir into <out>/.telemetry/<holder>/).
+    mdirs = {h: os.path.join(out, ".telemetry", h)
+             for h in ("h0", "h1", "h2")}
     # h0 dies at the os.replace publishing its FIRST gather ledger
     # record: it dies holding that unit's lease with the unit's work
     # fully done but unjournaled — the exact "host dies holding a unit"
@@ -232,7 +250,7 @@ def test_elastic_sigkill_one_host_survivors_byte_identical(
     procs = {
         "h0": _spawn_elastic(corpus, vocab, out, "h0", 2.0,
                              fault_spec="replace:kill:nth=1:path=_done/group-",
-                             metrics_dir=mdirs["h0"]),
+                             fleet=True),
     }
     records = os.path.join(out, "_done")
     deadline = time.monotonic() + 120
@@ -241,10 +259,8 @@ def test_elastic_sigkill_one_host_survivors_byte_identical(
                 n.startswith("scatter-") for n in os.listdir(records)):
             break
         time.sleep(0.1)
-    procs["h1"] = _spawn_elastic(corpus, vocab, out, "h1", 2.0,
-                                 metrics_dir=mdirs["h1"])
-    procs["h2"] = _spawn_elastic(corpus, vocab, out, "h2", 2.0,
-                                 metrics_dir=mdirs["h2"])
+    procs["h1"] = _spawn_elastic(corpus, vocab, out, "h1", 2.0, fleet=True)
+    procs["h2"] = _spawn_elastic(corpus, vocab, out, "h2", 2.0, fleet=True)
     outs = {h: p.communicate(timeout=600)[0] for h, p in procs.items()}
     assert procs["h0"].returncode == -9, outs["h0"]  # really SIGKILLed
     assert procs["h1"].returncode == 0, outs["h1"]
@@ -270,6 +286,49 @@ def test_elastic_sigkill_one_host_survivors_byte_identical(
     done = sum(_counter_total(m, "elastic_units_completed_total")
                for m in mdirs.values())
     assert done == 24, done
+
+    # ---- fleet acceptance: the report from telemetry artifacts alone.
+    import json as _json
+    merged_path = str(tmp_path / "merged_trace.json")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    status = subprocess.run(
+        [sys.executable, "-m", "tools.pipeline_status", out, "--json",
+         "--merge-trace", merged_path],
+        env=env, cwd=REPO_ROOT, capture_output=True, text=True)
+    # Exit 2: the dead host makes the report unhealthy by design.
+    assert status.returncode == 2, status.stdout + status.stderr
+    report = _json.loads(status.stdout)
+    # The SIGKILLed host is the one and only stalled host (it never wrote
+    # a clean-shutdown marker; the survivors did).
+    assert report["health"]["stalled_hosts"] == ["h0"]
+    assert sorted(report["health"]["closed_hosts"]) == ["h1", "h2"]
+    # Totals match the journaled ground truth computed above.
+    totals = report["totals"]["counters"]
+    assert totals["units_completed"] == 24
+    assert totals["steals"] >= 1
+    assert totals["steals"] >= steals
+    assert totals["fence_rejects"] == sum(
+        _counter_total(m, "lease_fence_rejects_total")
+        for m in mdirs.values())
+    # Lifecycle event log agrees with the counters: 24 unit.journaled
+    # events across the fleet, and the steal shows as unit.stolen.
+    journaled = sum(st["event_counts"].get("unit.journaled", 0)
+                    for st in report["hosts"].values())
+    assert journaled == 24
+    stolen_events = sum(st["event_counts"].get("unit.stolen", 0)
+                        for st in report["hosts"].values())
+    assert stolen_events >= 1
+    # The merged Chrome trace spans ALL three hosts, dead one included
+    # (its kill-fault flush published the pre-kill trace buffer).
+    merged = _json.load(open(merged_path))
+    lane_names = {ev["args"]["name"] for ev in merged
+                  if ev.get("ph") == "M"
+                  and ev.get("name") == "process_name"}
+    for h in ("h0", "h1", "h2"):
+        assert any(name.startswith(h + " ") for name in lane_names), (
+            h, sorted(lane_names))
+    assert any(ev.get("ph") == "X" for ev in merged)
 
 
 def test_elastic_forced_stall_fence_reject(fixture_dirs, reference_hashes,

@@ -2,6 +2,8 @@
 rejects bad input cleanly (the reference's 8-script surface,
 setup.py:63-73 / our pyproject [project.scripts])."""
 
+import os
+
 import pytest
 
 
@@ -63,6 +65,47 @@ def test_elastic_flags_parse_and_forward():
         ["--wikipedia", "c", "--sink", "s", "--vocab-file", "v"])
     kw = common.elastic_kwargs_of(args)
     assert kw["elastic"] is False and kw["holder_id"] is None
+
+
+def test_fleet_telemetry_flag_parses_and_arms(tmp_path):
+    """--fleet-telemetry parses on the preprocess and ingest CLIs and
+    arms the fleet env (spool under <sink>/.telemetry/<holder>/, metrics
+    colocated); without the flag nothing is armed."""
+    # Plain os.environ.pop, NOT monkeypatch.delenv: monkeypatch would
+    # RESTORE the armed value at teardown and leak it into later modules.
+    for name in ("LDDL_TPU_FLEET_DIR", "LDDL_TPU_FLEET_HOLDER",
+                 "LDDL_TPU_FLEET_TTL_S", "LDDL_TPU_FLEET_INTERVAL_S",
+                 "LDDL_TPU_METRICS_DIR"):
+        os.environ.pop(name, None)
+    from lddl_tpu.cli import common
+    from lddl_tpu.cli.ingest_watch import attach_args as ingest_args
+    from lddl_tpu.cli.preprocess_bert_pretrain import attach_args
+    from lddl_tpu.observability import fleet
+    fleet._reset_for_tests()
+    sink = str(tmp_path / "sink")
+    args = attach_args().parse_args(
+        ["--wikipedia", "c", "--sink", sink, "--vocab-file", "v"])
+    assert args.fleet_telemetry is False
+    common.arm_fleet_if_requested(args, args.sink)
+    assert not fleet.enabled()
+    args = ingest_args().parse_args(
+        ["--landing", "l", "--sink", sink, "--vocab-file", "v",
+         "--fleet-telemetry", "--elastic-host-id", "hZ",
+         "--lease-ttl", "7"])
+    assert args.fleet_telemetry is True
+    common.arm_fleet_if_requested(args, args.sink)
+    try:
+        assert fleet.enabled() and fleet.fleet_dir() == sink
+        assert fleet.holder() == "hZ"
+        assert fleet.spool_dir() == os.path.join(sink, ".telemetry", "hZ")
+        import lddl_tpu.observability as obs
+        assert obs.metrics_dir() == fleet.spool_dir()
+    finally:
+        fleet._reset_for_tests()
+        for name in ("LDDL_TPU_FLEET_DIR", "LDDL_TPU_FLEET_HOLDER",
+                     "LDDL_TPU_FLEET_TTL_S", "LDDL_TPU_FLEET_INTERVAL_S",
+                     "LDDL_TPU_METRICS_DIR"):
+            os.environ.pop(name, None)
 
 
 def test_elastic_and_multihost_mutually_exclusive():

@@ -427,6 +427,41 @@ def test_publish_path_flow_observability_writes_exempt(tmp_path):
     assert flow_findings(report) == []
 
 
+def test_publish_path_flow_fleet_spool_writes_exempt_shard_write_caught(
+        tmp_path):
+    """The fleet-telemetry spool writers (.telemetry/ event logs and
+    snapshots, observability/fleet.py) are non-shard sinks by
+    construction: lifecycle emission from the elastic claim loop must not
+    read as a publish violation. A raw write laundered through a
+    NON-exempt helper on the same call path is still caught — the
+    exemption is the module, never the caller."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/observability/fleet.py": """
+            def flush_events(spool_dir, batch):
+                with open(spool_dir + "/events-pid0.jsonl", "a") as f:
+                    f.write(batch)
+        """,
+        "lddl_tpu/utils/rawio.py": """
+            def dump(path, data):
+                with open(path, "w") as f:
+                    f.write(data)
+        """,
+        "lddl_tpu/preprocess/steal.py": """
+            from ..observability.fleet import flush_events
+            from ..utils.rawio import dump
+
+            def complete_unit(out_dir, rec):
+                flush_events(out_dir + "/.telemetry/h0", rec)
+                dump(out_dir + "/part.0.txt", rec)
+        """,
+    }, rules=["publish-path-flow"])
+    findings = flow_findings(report, "publish-path-flow")
+    assert len(findings) == 1, [f.format() for f in findings]
+    assert findings[0].path == "lddl_tpu/preprocess/steal.py"
+    assert "dump" in findings[0].message
+    assert "flush_events" not in findings[0].message
+
+
 # ------------------------------------------ lease-isolation fixtures
 
 

@@ -1,0 +1,682 @@
+"""Fleet telemetry (lddl_tpu/observability/fleet.py + tools/
+pipeline_status.py): spool publishing, torn-tail tolerance, cluster
+aggregation with stall/wedge verdicts, clock-aligned trace merging,
+abnormal-exit flushing (SIGTERM + SIGKILL), and — the contract that
+matters most — byte-inertness: fleet telemetry on vs off changes no
+shard, manifest, journal, or batch byte.
+
+The real 3-process SIGKILL acceptance run (dead host identified from
+telemetry alone, totals matching journaled ground truth, merged trace
+spanning all hosts) lives in tests/test_chaos.py (-m slow); here the
+subprocesses are cheap observability-only drivers so the suite stays
+inside tier-1's budget.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu import observability as obs  # noqa: E402
+from lddl_tpu.observability import fleet, tracing  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_FLEET_ENVS = (fleet.ENV_FLEET_DIR, fleet.ENV_HOLDER, fleet.ENV_TTL,
+               fleet.ENV_INTERVAL, "LDDL_TPU_METRICS_DIR",
+               "LDDL_TPU_METRICS_RANK")
+
+
+def _scrub_env():
+    # Plain os.environ.pop, NOT monkeypatch.delenv: monkeypatch records
+    # the deleted value and RESTORES it at teardown, which would leak an
+    # armed metrics dir into later test modules.
+    for name in _FLEET_ENVS:
+        os.environ.pop(name, None)
+
+
+@pytest.fixture
+def clean_telemetry():
+    """Fleet/metrics armed state is process-global env + module state;
+    isolate each test."""
+    _scrub_env()
+    obs.registry().reset()
+    tracing._reset_for_tests()
+    fleet._reset_for_tests()
+    yield
+    _scrub_env()
+    obs.registry().reset()
+    tracing._reset_for_tests()
+    fleet._reset_for_tests()
+
+
+# ------------------------------------------------------------- publishing
+
+
+def test_disabled_everything_is_noop(clean_telemetry, tmp_path):
+    assert not fleet.enabled()
+    fleet.record("unit.claimed", unit="u0", epoch=0)
+    assert fleet.heartbeat() is None
+    assert fleet.flush_events() is None
+    fleet.ensure_started()
+    assert fleet._hb["thread"] is None
+    assert not os.path.isdir(str(tmp_path / ".telemetry"))
+
+
+def test_spool_publish_and_roundtrip(clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    spool = fleet.configure(root, holder_id="hostA", ttl=5, interval=60)
+    assert spool == os.path.join(root, ".telemetry", "hostA")
+    # configure() armed metrics into the spool (none were armed before).
+    assert obs.metrics_dir() == spool
+    fleet.record("unit.claimed", unit="group-1", epoch=0, holder="hostA")
+    fleet.record("unit.journaled", unit="group-1", epoch=0, holder="hostA",
+                 phase="gather")
+    obs.inc("elastic_units_completed_total", 1, phase="gather")
+    fleet.heartbeat()
+    pid = os.getpid()
+    events, torn = fleet.read_jsonl(
+        os.path.join(spool, "events-pid{}.jsonl".format(pid)))
+    assert torn == 0
+    assert [ev["kind"] for ev in events] == ["unit.claimed",
+                                             "unit.journaled"]
+    assert all("wall" in ev and "mono" in ev for ev in events)
+    snap = fleet._read_json(
+        os.path.join(spool, "snapshot-pid{}.json".format(pid)))
+    assert snap["holder"] == "hostA" and snap["closed"] is False
+    assert snap["ttl_s"] == 5.0
+    assert "elastic_units_completed_total" in snap["metrics"]
+    # Clean shutdown marks the snapshot closed.
+    fleet.heartbeat(closed=True, reason="test")
+    snap = fleet._read_json(
+        os.path.join(spool, "snapshot-pid{}.json".format(pid)))
+    assert snap["closed"] is True and snap["closed_reason"] == "test"
+
+
+def test_env_only_arming_colocates_metrics(clean_telemetry, tmp_path):
+    """Arming via LDDL_TPU_FLEET_DIR alone (no configure(), no
+    --fleet-telemetry) must still produce non-empty registry snapshots:
+    the first record() points the metrics dir at the spool, so the
+    status report never silently shows every counter as zero."""
+    os.environ[fleet.ENV_FLEET_DIR] = str(tmp_path)
+    os.environ[fleet.ENV_HOLDER] = "envhost"
+    os.environ[fleet.ENV_INTERVAL] = "60"
+    fleet.record("unit.claimed", unit="u0", epoch=0, holder="envhost")
+    assert obs.metrics_dir() == fleet.spool_dir()
+    obs.inc("elastic_units_completed_total", 1, phase="gather")
+    fleet.heartbeat()
+    report = fleet.aggregate(str(tmp_path))
+    assert report["hosts"]["envhost"]["counters"]["units_completed"] == 1
+
+
+def test_read_jsonl_torn_tail_is_end_of_stream(tmp_path):
+    p = str(tmp_path / "events.jsonl")
+    with open(p, "w") as f:
+        f.write(json.dumps({"kind": "a", "wall": 1.0}) + "\n")
+        f.write(json.dumps({"kind": "b", "wall": 2.0}) + "\n")
+        f.write('{"kind": "c", "wal')  # torn mid-append
+    warnings = []
+    records, torn = fleet.read_jsonl(p, warn=lambda msg, *a: warnings.append(
+        msg % a if a else msg))
+    assert [r["kind"] for r in records] == ["a", "b"]
+    assert torn == 1
+    assert any("end-of-stream" in w for w in warnings)
+    # Torn INTERIOR line: skipped with a warning, the tail still parses.
+    with open(p, "w") as f:
+        f.write('{"kind": "a"\n')
+        f.write(json.dumps({"kind": "b"}) + "\n")
+        f.write(json.dumps({"kind": "c"}) + "\n")
+    records, torn = fleet.read_jsonl(p, warn=lambda *a: None)
+    assert [r["kind"] for r in records] == ["b", "c"] and torn == 1
+
+
+# ------------------------------------------------------------- aggregation
+
+
+def _fake_spool(root, holder, pid, wall, counters=None, gauges=None,
+                closed=False, ttl=5.0, events=(), torn_tail=False,
+                started=None):
+    d = os.path.join(root, ".telemetry", holder)
+    os.makedirs(d, exist_ok=True)
+    metrics = {}
+    for name, total in (counters or {}).items():
+        metrics[name] = {"type": "counter", "values": {"": total}}
+    for name, value in (gauges or {}).items():
+        metrics[name] = {"type": "gauge", "values": {"": value}}
+    snap = {"holder": holder, "pid": pid, "rank": 0, "wall": wall,
+            "mono": 100.0, "started_wall": started if started is not None
+            else wall - 60.0, "interval_s": 1.0, "ttl_s": ttl,
+            "closed": closed, "metrics": metrics}
+    with open(os.path.join(d, "snapshot-pid{}.json".format(pid)), "w") as f:
+        json.dump(snap, f)
+    with open(os.path.join(d, "events-pid{}.jsonl".format(pid)), "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+        if torn_tail:
+            f.write('{"kind": "unit.cl')
+    return d
+
+
+def test_aggregate_flags_dead_host_stalled(tmp_path):
+    root = str(tmp_path)
+    now = 10000.0
+    _fake_spool(root, "h-live", 1, wall=now - 1.0, ttl=5.0,
+                counters={"elastic_units_completed_total": 10,
+                          "lease_steals_total": 2},
+                events=[{"kind": "unit.journaled", "wall": now - 1.0,
+                         "mono": 99.0, "pid": 1}])
+    _fake_spool(root, "h-closed", 2, wall=now - 500.0, ttl=5.0, closed=True,
+                counters={"elastic_units_completed_total": 5})
+    _fake_spool(root, "h-dead", 3, wall=now - 300.0, ttl=5.0,
+                counters={"elastic_units_completed_total": 9,
+                          "lease_fence_rejects_total": 1},
+                events=[{"kind": "unit.claimed", "wall": now - 301.0,
+                         "mono": 50.0, "pid": 3}],
+                torn_tail=True)
+    report = fleet.aggregate(root, now=now, warn=lambda *a: None)
+    health = report["health"]
+    assert health["stalled_hosts"] == ["h-dead"]
+    assert health["closed_hosts"] == ["h-closed"]
+    assert health["live_hosts"] == ["h-live"]
+    assert not health["ok"]
+    assert any("h-dead" in v and "STALLED" in v for v in health["verdicts"])
+    # The dead host's partial spool still contributes coherent numbers.
+    assert report["hosts"]["h-dead"]["counters"]["units_completed"] == 9
+    assert report["hosts"]["h-dead"]["torn_lines"] == 1
+    assert report["totals"]["counters"]["units_completed"] == 24
+    assert report["totals"]["counters"]["steals"] == 2
+    assert report["totals"]["counters"]["fence_rejects"] == 1
+    json.dumps(report)  # the --json contract: fully serializable
+
+
+def test_wedge_requires_pending_work(tmp_path):
+    root = str(tmp_path)
+    now = 50000.0
+    # A live host, heartbeating, whose last progress is ancient.
+    old_progress = [{"kind": "generation.committed", "wall": now - 10000.0,
+                     "mono": 1.0, "pid": 7}]
+    _fake_spool(root, "svc", 7, wall=now - 1.0, ttl=5.0,
+                events=old_progress)
+    # No pending work -> idle, not wedged.
+    report = fleet.aggregate(root, now=now, wedge_window=60.0)
+    assert not report["health"]["wedged"] and report["health"]["ok"]
+    # Pending work (nonzero backlog gauge) -> wedged.
+    _fake_spool(root, "svc", 7, wall=now - 1.0, ttl=5.0,
+                gauges={"ingest_backlog_docs": 12}, events=old_progress)
+    report = fleet.aggregate(root, now=now, wedge_window=60.0)
+    assert report["health"]["wedged"] and not report["health"]["ok"]
+    assert any("WEDGED" in v for v in report["health"]["verdicts"])
+    # Fresh progress inside the window heals it.
+    _fake_spool(root, "svc", 7, wall=now - 1.0, ttl=5.0,
+                gauges={"ingest_backlog_docs": 12},
+                events=[{"kind": "generation.committed", "wall": now - 5.0,
+                         "mono": 2.0, "pid": 7}])
+    report = fleet.aggregate(root, now=now, wedge_window=60.0)
+    assert not report["health"]["wedged"]
+
+
+def test_wedge_no_progress_ever_counts_from_host_start(tmp_path):
+    """A fresh service whose FIRST unit/generation is still in flight has
+    no progress stamp at all — that must not instant-wedge it; the
+    window counts from the earliest host start instead."""
+    root = str(tmp_path)
+    now = 90000.0
+    # Started 10s ago, window 60s: healthy, just young.
+    _fake_spool(root, "svc", 7, wall=now - 1.0, ttl=5.0,
+                gauges={"ingest_backlog_docs": 3}, events=[],
+                started=now - 10.0)
+    report = fleet.aggregate(root, now=now, wedge_window=60.0)
+    assert not report["health"]["wedged"], report["health"]["verdicts"]
+    # Same host started 500s ago with still no progress: wedged.
+    _fake_spool(root, "svc", 7, wall=now - 1.0, ttl=5.0,
+                gauges={"ingest_backlog_docs": 3}, events=[],
+                started=now - 500.0)
+    report = fleet.aggregate(root, now=now, wedge_window=60.0)
+    assert report["health"]["wedged"]
+
+
+def test_cli_auto_holder_names_spool_and_leases_identically(tmp_path):
+    """--fleet-telemetry on an elastic run WITHOUT --elastic-host-id must
+    still give the spool and the lease files one shared holder name (an
+    auto-generated lease holder is pinned into the args before the
+    kwargs snapshot)."""
+    from lddl_tpu.cli import common
+    from lddl_tpu.cli.preprocess_bert_pretrain import attach_args
+    _scrub_env()
+    fleet._reset_for_tests()
+    try:
+        args = attach_args().parse_args(
+            ["--wikipedia", "c", "--sink", str(tmp_path / "sink"),
+             "--vocab-file", "v", "--elastic", "--fleet-telemetry"])
+        assert args.elastic_host_id is None
+        common.arm_fleet_if_requested(args, args.sink)
+        assert args.elastic_host_id is not None
+        assert fleet.holder() == args.elastic_host_id
+        assert common.elastic_kwargs_of(args)["holder_id"] \
+            == args.elastic_host_id
+    finally:
+        fleet._reset_for_tests()
+        _scrub_env()
+
+
+def test_pipeline_status_cli_exit_codes_and_json(tmp_path, capsys):
+    from tools import pipeline_status
+
+    root = str(tmp_path)
+    _fake_spool(root, "h-ok", 1, wall=time.time(), closed=True,
+                counters={"elastic_units_completed_total": 3})
+    assert pipeline_status.main([root, "--json"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["health"]["ok"]
+    assert report["hosts"]["h-ok"]["counters"]["units_completed"] == 3
+    # A stalled host flips the exit code to 2 in text mode too.
+    _fake_spool(root, "h-dead", 2, wall=time.time() - 900.0, ttl=5.0,
+                counters={"elastic_units_completed_total": 1})
+    assert pipeline_status.main([root]) == 2
+    out = capsys.readouterr().out
+    assert "UNHEALTHY" in out and "STALLED" in out and "h-dead" in out
+
+
+# ------------------------------------------------------------ trace merge
+
+
+def test_clock_step_correction_unit():
+    # Stable clock: no correction segments.
+    assert fleet._step_corrections([(0.0, 2000.0), (10.0, 2010.0)]) == []
+    # A +100s wall step between samples: later events shift back.
+    segs = fleet._step_corrections([(0.0, 2000.0), (10.0, 2110.0)])
+    assert segs == [(2110.0, pytest.approx(100.0))]
+    assert fleet._corrected_ts(2115.0 * 1e6, segs) == \
+        pytest.approx(2015.0 * 1e6)
+    # Events before the step are untouched.
+    assert fleet._corrected_ts(2005.0 * 1e6, segs) == \
+        pytest.approx(2005.0 * 1e6)
+
+
+def _write_trace(root, holder, pid, events):
+    d = os.path.join(root, ".telemetry", holder)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "trace-rank0-pid{}.jsonl".format(pid)),
+              "w") as f:
+        for ev in events:
+            f.write(json.dumps(ev) + "\n")
+
+
+def test_merge_traces_spans_hosts_with_alignment(tmp_path):
+    root = str(tmp_path)
+    # hostA: stable clock.
+    _fake_spool(root, "hostA", 1, wall=3000.0,
+                events=[{"kind": "clock", "wall": 1000.0, "mono": 0.0,
+                         "pid": 1},
+                        {"kind": "clock", "wall": 1010.0, "mono": 10.0,
+                         "pid": 1}])
+    _write_trace(root, "hostA", 1, [
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "rank0 pid1"}},
+        {"name": "preprocess.gather", "ph": "X", "ts": 1005.0 * 1e6,
+         "dur": 5e6, "pid": 1, "tid": 1},
+    ])
+    # hostB: wall clock stepped +100s mid-run; pid collides with hostA's.
+    _fake_spool(root, "hostB", 1, wall=4000.0,
+                events=[{"kind": "clock", "wall": 2000.0, "mono": 0.0,
+                         "pid": 1},
+                        {"kind": "clock", "wall": 2110.0, "mono": 10.0,
+                         "pid": 1}])
+    _write_trace(root, "hostB", 1, [
+        {"name": "preprocess.gather", "ph": "X", "ts": 2115.0 * 1e6,
+         "dur": 5e6, "pid": 1, "tid": 1},
+    ])
+    events, lanes = fleet.merge_traces(root, warn=lambda *a: None)
+    assert [(h, p) for _, h, p in lanes] == [("hostA", 1), ("hostB", 1)]
+    names = {}
+    spans = []
+    for ev in events:
+        if ev["ph"] == "M" and ev["name"] == "process_name":
+            names[ev["pid"]] = ev["args"]["name"]
+        elif ev["ph"] == "X":
+            spans.append(ev)
+    # Per-host lanes: the colliding real pids land on distinct lane pids.
+    assert sorted(names.values()) == ["hostA pid1", "hostB pid1"]
+    assert len({ev["pid"] for ev in spans}) == 2
+    # hostB's post-step span was re-anchored (2115 -> 2015).
+    by_lane = {names[ev["pid"]]: ev for ev in spans}
+    assert by_lane["hostB pid1"]["ts"] == pytest.approx(2015.0 * 1e6)
+    assert by_lane["hostA pid1"]["ts"] == pytest.approx(1005.0 * 1e6)
+
+
+def test_trace_summary_merge_cli(tmp_path, capsys):
+    from tools import trace_summary
+
+    root = str(tmp_path)
+    _fake_spool(root, "hostA", 1, wall=3000.0)
+    _write_trace(root, "hostA", 1, [
+        {"name": "preprocess.gather", "ph": "X", "ts": 1e9, "dur": 1e6,
+         "pid": 1, "tid": 1}])
+    _fake_spool(root, "hostB", 2, wall=3000.0)
+    _write_trace(root, "hostB", 2, [
+        {"name": "balance.run", "ph": "X", "ts": 2e9, "dur": 1e6,
+         "pid": 2, "tid": 1}])
+    out_path = str(tmp_path / "merged.json")
+    assert trace_summary.main([root, "--merge", out_path]) == 0
+    text = capsys.readouterr().out
+    # Summary mode found both hosts' spool traces via .telemetry/.
+    assert "preprocess" in text and "balance" in text
+    merged = json.load(open(out_path))
+    lanes = {ev["args"]["name"] for ev in merged
+             if ev.get("ph") == "M" and ev.get("name") == "process_name"}
+    assert lanes == {"hostA pid1", "hostB pid2"}
+
+
+# ------------------------------------------------- abnormal-exit flushing
+
+_SIGTERM_DRIVER = """
+import os, sys, time
+root = sys.argv[1]
+os.environ["LDDL_TPU_FLEET_DIR"] = root
+os.environ["LDDL_TPU_FLEET_HOLDER"] = "polite"
+os.environ["LDDL_TPU_FLEET_INTERVAL_S"] = "3600"  # only exit paths flush
+from lddl_tpu.observability import fleet
+fleet.ensure_started()
+fleet.record("unit.claimed", unit="group-0", epoch=0, holder="polite")
+print("READY", flush=True)
+time.sleep(120)
+"""
+
+_SIGKILL_DRIVER = """
+import os, sys, time
+root = sys.argv[1]
+os.environ["LDDL_TPU_FLEET_DIR"] = root
+os.environ["LDDL_TPU_FLEET_HOLDER"] = "victim"
+os.environ["LDDL_TPU_FLEET_TTL_S"] = "2"
+os.environ["LDDL_TPU_FLEET_INTERVAL_S"] = "0.05"
+from lddl_tpu.observability import fleet
+import lddl_tpu.observability as obs
+fleet.configure(root, holder_id="victim", ttl=2, interval=0.05)
+i = 0
+while True:
+    fleet.record("unit.claimed", unit="g%d" % i, epoch=0, holder="victim")
+    obs.inc("elastic_units_completed_total", 1, phase="gather")
+    fleet.record("unit.journaled", unit="g%d" % i, epoch=0,
+                 holder="victim")
+    i += 1
+    time.sleep(0.01)
+"""
+
+
+def _spawn(driver, root):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    for name in _FLEET_ENVS:
+        env.pop(name, None)
+    return subprocess.Popen([sys.executable, "-c", driver, root],
+                            env=env, cwd=REPO_ROOT, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+
+
+def test_sigterm_flushes_events_and_marks_closed(tmp_path):
+    """A politely-killed host (TERM) leaves a fully-flushed spool with a
+    clean-shutdown marker — the heartbeat interval is set far past the
+    test, so ONLY the signal handler can have written these bytes."""
+    root = str(tmp_path)
+    proc = _spawn(_SIGTERM_DRIVER, root)
+    assert proc.stdout.readline().strip() == "READY"
+    proc.send_signal(signal.SIGTERM)
+    out = proc.communicate(timeout=60)[0]
+    assert proc.returncode == -signal.SIGTERM, out
+    spool = os.path.join(root, ".telemetry", "polite")
+    events_files = [n for n in sorted(os.listdir(spool))
+                    if n.startswith("events-pid")]
+    assert events_files, sorted(os.listdir(spool))
+    records, torn = fleet.read_jsonl(os.path.join(spool, events_files[0]))
+    assert torn == 0
+    assert [r["kind"] for r in records] == ["unit.claimed"]
+    snaps = [n for n in sorted(os.listdir(spool))
+             if n.startswith("snapshot-pid")]
+    snap = fleet._read_json(os.path.join(spool, snaps[0]))
+    assert snap["closed"] is True and snap["closed_reason"] == "sigterm"
+    # Closed hosts are never stall-flagged, no matter how old the beat.
+    report = fleet.aggregate(root, now=time.time() + 10000.0)
+    assert report["health"]["stalled_hosts"] == []
+    assert report["health"]["closed_hosts"] == ["polite"]
+
+
+_SIGIGN_DRIVER = """
+import os, signal, sys, time
+signal.signal(signal.SIGTERM, signal.SIG_IGN)  # app chose to ignore TERM
+root = sys.argv[1]
+os.environ["LDDL_TPU_FLEET_DIR"] = root
+os.environ["LDDL_TPU_FLEET_HOLDER"] = "ignorer"
+os.environ["LDDL_TPU_FLEET_INTERVAL_S"] = "3600"
+from lddl_tpu.observability import fleet
+fleet.ensure_started()
+fleet.record("unit.claimed", unit="g0", epoch=0, holder="ignorer")
+print("READY", flush=True)
+time.sleep(2.0)
+print("SURVIVED", flush=True)
+"""
+
+
+def test_sigterm_flush_preserves_sig_ign(tmp_path):
+    """A process that had SIGTERM ignored must stay ignored: the flush
+    handler flushes the spool but never turns an ignored signal into a
+    death."""
+    root = str(tmp_path)
+    proc = _spawn(_SIGIGN_DRIVER, root)
+    assert proc.stdout.readline().strip() == "READY"
+    proc.send_signal(signal.SIGTERM)
+    out = proc.communicate(timeout=60)[0]
+    assert proc.returncode == 0, out
+    assert "SURVIVED" in out
+    spool = os.path.join(root, ".telemetry", "ignorer")
+    events_files = [n for n in sorted(os.listdir(spool))
+                    if n.startswith("events-pid")]
+    records, _ = fleet.read_jsonl(os.path.join(spool, events_files[0]))
+    assert any(r["kind"] == "unit.claimed" for r in records)
+
+
+def test_sigkill_leaves_parseable_spool_and_stall_verdict(tmp_path):
+    """A SIGKILLed host can flush nothing at death; the heartbeat trail
+    it left must still aggregate into a coherent report that flags it
+    stalled (no clean-shutdown marker) and preserves its counters."""
+    root = str(tmp_path)
+    proc = _spawn(_SIGKILL_DRIVER, root)
+    spool = os.path.join(root, ".telemetry", "victim")
+    deadline = time.monotonic() + 60.0
+    target = os.path.join(spool, "snapshot-pid{}.json".format(proc.pid))
+    while time.monotonic() < deadline:
+        snap = fleet._read_json(target, warn=lambda *a: None) \
+            if os.path.exists(target) else None
+        if snap and fleet._counter_total(
+                snap.get("metrics"), "elastic_units_completed_total") >= 5:
+            break
+        time.sleep(0.02)
+    proc.kill()
+    proc.communicate(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+    report = fleet.aggregate(root, now=time.time() + 60.0,
+                             warn=lambda *a: None)
+    host = report["hosts"]["victim"]
+    assert not host["closed"]
+    assert report["health"]["stalled_hosts"] == ["victim"]
+    assert host["counters"]["units_completed"] >= 5
+    assert host["event_counts"].get("unit.claimed", 0) >= 1
+    json.dumps(report)
+
+
+# ----------------------------------------------- byte-inertness (elastic)
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("fleet")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    return str(td), corpus, vocab
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    with open(gs.GOLDEN_FILE) as f:
+        return json.load(f)
+
+
+def _bert_processor(vocab, out_dir):
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    from lddl_tpu.preprocess.runner import BertBucketProcessor
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True,
+                             schema_version=1)
+    return BertBucketProcessor(tok, cfg, 4242, out_dir, 8, "parquet")
+
+
+_RUN_KW = dict(num_blocks=12, sample_ratio=0.9, seed=4242,
+               global_shuffle=True, progress_interval=0.0)
+
+
+def test_two_host_elastic_with_fleet_is_byte_inert_and_aggregates(
+        clean_telemetry, fixture_dirs, goldens, tmp_path, capsys):
+    """The acceptance pin, fast flavor: a 2-host elastic run with fleet
+    telemetry armed produces shards byte-identical to the pinned goldens
+    (= a telemetry-off run) and a manifest byte-identical to a
+    telemetry-off elastic run, while the spool aggregates to the run's
+    journaled ground truth (24 units) and the merged trace carries the
+    stage spans."""
+    from lddl_tpu.preprocess.runner import run_sharded_pipeline
+
+    td, corpus, vocab = fixture_dirs
+    # Reference: telemetry-off elastic run (same plan).
+    ref = str(tmp_path / "ref")
+    run_sharded_pipeline({"wikipedia": corpus}, ref,
+                         _bert_processor(vocab, ref), elastic=True,
+                         lease_ttl=5.0, holder_id="refhost", **_RUN_KW)
+    assert gs.hash_outputs(ref) == goldens["binned_masked"]
+
+    out = str(tmp_path / "out")
+    fleet.configure(out, holder_id="fleethost", ttl=5.0, interval=60)
+    procs = {h: _bert_processor(vocab, out) for h in ("hostA", "hostB")}
+    results, errors = {}, {}
+
+    def host(hid, delay):
+        time.sleep(delay)
+        try:
+            results[hid] = run_sharded_pipeline(
+                {"wikipedia": corpus}, out, procs[hid], elastic=True,
+                lease_ttl=5.0, holder_id=hid, **_RUN_KW)
+        except Exception as e:  # noqa: BLE001 - surfaced via assert
+            errors[hid] = e
+
+    threads = [threading.Thread(target=host, args=("hostA", 0.0)),
+               threading.Thread(target=host, args=("hostB", 0.1))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # Shards: byte-identical to the goldens (telemetry-off bytes).
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    # Manifest: byte-identical to the telemetry-off elastic reference.
+    with open(os.path.join(ref, ".manifest.json"), "rb") as f:
+        want = f.read()
+    with open(os.path.join(out, ".manifest.json"), "rb") as f:
+        assert f.read() == want
+    # Spool aggregates to the journaled ground truth: 12 scatter + 12
+    # gather units, all lifecycle-logged (both thread-hosts share one
+    # process, hence one spool).
+    fleet.heartbeat(closed=True, reason="test")
+    report = fleet.aggregate(out)
+    assert report["totals"]["counters"]["units_completed"] == 24
+    counts = report["hosts"]["fleethost"]["event_counts"]
+    assert counts.get("unit.journaled") == 24
+    assert counts.get("unit.claimed", 0) >= 24  # epoch-0 claims
+    assert report["health"]["ok"], report["health"]["verdicts"]
+
+    # pipeline_status --json over the same artifacts agrees.
+    from tools import pipeline_status
+    assert pipeline_status.main([out, "--json"]) == 0
+    cli_report = json.loads(capsys.readouterr().out)
+    assert cli_report["totals"]["counters"]["units_completed"] == 24
+
+    # The merged trace spans the run's stage spans.
+    events, lanes = fleet.merge_traces(out)
+    span_names = {ev.get("name") for ev in events if ev.get("ph") == "X"}
+    assert "preprocess.gather" in span_names
+    assert "preprocess.finalize" in span_names
+    assert lanes and lanes[0][1] == "fleethost"
+
+
+# --------------------------------------------- byte-inertness (ingest)
+
+
+def test_ingest_with_fleet_is_byte_inert_and_logs_lifecycle(
+        clean_telemetry, fixture_dirs, tmp_path):
+    """Streaming-ingest flavor of the inertness pin: fleet telemetry on
+    vs off leaves shards, manifests, the intake journal, and the loader's
+    batch stream byte-identical — and the spool carries the generation
+    lifecycle (intake -> preprocess -> delta-balance -> gate-advance ->
+    committed)."""
+    import shutil
+
+    from lddl_tpu.ingest import ingest_once
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+
+    td, corpus, vocab = fixture_dirs
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=False)
+    landing = str(tmp_path / "landing")
+    os.makedirs(os.path.join(landing, "source"))
+    shutil.copy(os.path.join(corpus, "source", "0.txt"),
+                os.path.join(landing, "source", "0.txt"))
+    kw = dict(config=cfg, num_shards=4, seed=7, num_blocks=4)
+
+    root_off = str(tmp_path / "off")
+    ingest_once(root_off, tok, landing=landing, **kw)
+
+    root_on = str(tmp_path / "on")
+    fleet.configure(root_on, holder_id="svc", ttl=5.0, interval=60)
+    ingest_once(root_on, tok, landing=landing, **kw)
+    fleet.heartbeat(closed=True)
+
+    def tree_bytes(root):
+        out = {}
+        for base, dirs, files in os.walk(root):
+            dirs[:] = sorted(d for d in dirs if d != ".telemetry")
+            for name in sorted(files):
+                p = os.path.join(base, name)
+                with open(p, "rb") as f:
+                    out[os.path.relpath(p, root)] = f.read()
+        return out
+
+    off, on = tree_bytes(root_off), tree_bytes(root_on)
+    assert sorted(off) == sorted(on)
+    for rel in off:
+        assert on[rel] == off[rel], rel
+
+    a = [{k: v for k, v in b.items()} for b in get_bert_pretrain_data_loader(
+        root_off, vocab_file=vocab, batch_size=8, base_seed=5)]
+    b = [{k: v for k, v in b.items()} for b in get_bert_pretrain_data_loader(
+        root_on, vocab_file=vocab, batch_size=8, base_seed=5,
+        follow_generations=True)]
+    assert len(a) == len(b) and len(a) > 0
+    import numpy as np
+    for x, y in zip(a, b):
+        assert sorted(x) == sorted(y)
+        for k in x:
+            np.testing.assert_array_equal(np.asarray(x[k]),
+                                          np.asarray(y[k]), err_msg=k)
+
+    report = fleet.aggregate(root_on)
+    counts = report["hosts"]["svc"]["event_counts"]
+    for kind in ("generation.intake", "generation.preprocess",
+                 "generation.delta_balance", "generation.gate_advance",
+                 "generation.committed"):
+        assert counts.get(kind, 0) >= 1, (kind, counts)
+    assert report["health"]["ok"], report["health"]["verdicts"]

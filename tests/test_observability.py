@@ -210,9 +210,12 @@ def test_every_stage_entry_point_opens_a_top_level_span():
     from lddl_tpu import analysis
     from lddl_tpu.analysis.rules import STAGE_SPANS
     assert set(STAGE_SPANS.items()) == {
-        ("lddl_tpu/preprocess/runner.py", "preprocess.run"),
-        ("lddl_tpu/balance/balancer.py", "balance.run"),
-        ("lddl_tpu/loader/dataloader.py", "loader.epoch"),
+        ("lddl_tpu/preprocess/runner.py", ("preprocess.run",)),
+        ("lddl_tpu/preprocess/steal.py", ("preprocess.gather",
+                                          "preprocess.finalize")),
+        ("lddl_tpu/balance/balancer.py", ("balance.run",)),
+        ("lddl_tpu/loader/dataloader.py", ("loader.epoch",)),
+        ("lddl_tpu/ingest/incremental.py", ("ingest.run",)),
     }
     report = analysis.run_check(
         ["lddl_tpu"], rules=analysis.get_rules(["stage-span"]))

@@ -1,0 +1,176 @@
+"""Bench-trajectory tracker: regression/improvement table over the
+committed benchmark artifact series.
+
+Usage::
+
+    python -m tools.bench_trajectory [--repo-root DIR] [--json]
+
+Reads the checked-in ``BENCH_r*.json`` preprocess-headline series and
+``LOADER_BENCH.json``, and prints a calibration-normalized trajectory
+table. The ROADMAP rule is **compare calibrations, not rounds**: the
+bench VM drifts between rounds, so a raw MB/s delta conflates code
+changes with host changes. Rounds that recorded
+``parsed.config.host_calibration_s`` (the wall time of a fixed reference
+workload on that round's host — larger = slower host) are normalized to
+the newest calibrated round's host speed::
+
+    normalized = value * (host_calibration_s / reference_calibration_s)
+
+Rounds without a calibration (r01–r03 predate it) print raw with an
+``uncal`` marker and are excluded from the verdict. The final verdict
+line compares the newest calibrated round against the previous one and
+is **informational only** — ``tools/ci_check.sh`` runs this non-gating,
+the exit status is always 0 when the artifacts parse.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+try:
+    from tools.trace_summary import _table  # python -m tools.*
+except ImportError:  # direct script invocation: tools/ is sys.path[0]
+    from trace_summary import _table
+
+
+def load_bench_series(repo_root):
+    """[(round_tag, value_mb_s, calibration_s_or_None)] sorted by round."""
+    rows = []
+    for path in sorted(glob.glob(os.path.join(repo_root, "BENCH_r*.json"))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print("warning: unreadable bench artifact {} ({}); skipped"
+                  .format(path, e), file=sys.stderr)
+            continue
+        parsed = doc.get("parsed") or {}
+        value = parsed.get("value")
+        if value is None:
+            continue
+        cal = (parsed.get("config") or {}).get("host_calibration_s")
+        tag = os.path.basename(path)[len("BENCH_"):-len(".json")]
+        rows.append((tag, float(value),
+                     float(cal) if cal is not None else None))
+    return rows
+
+
+def normalize(rows):
+    """Attach a calibration-normalized value per row (None when the row
+    or the series has no calibration). Reference = the NEWEST calibrated
+    round, so the latest number reads unchanged and history is restated
+    in today's host-speed units."""
+    ref = None
+    for _, _, cal in reversed(rows):
+        if cal is not None:
+            ref = cal
+            break
+    out = []
+    for tag, value, cal in rows:
+        norm = value * (cal / ref) if (cal is not None and ref) else None
+        out.append({"round": tag, "mb_per_s": value, "calibration_s": cal,
+                    "normalized_mb_per_s": norm})
+    return out
+
+
+def verdict(series):
+    cal_rounds = [r for r in series if r["normalized_mb_per_s"] is not None]
+    if len(cal_rounds) < 2:
+        return {"verdict": "insufficient calibrated rounds", "delta_pct": None}
+    prev, last = cal_rounds[-2], cal_rounds[-1]
+    delta = (last["normalized_mb_per_s"] / prev["normalized_mb_per_s"]
+             - 1.0) * 100.0
+    word = ("improvement" if delta > 2.0 else
+            "regression" if delta < -2.0 else "flat")
+    return {
+        "verdict": word,
+        "delta_pct": delta,
+        "from_round": prev["round"],
+        "to_round": last["round"],
+    }
+
+
+def load_loader_bench(repo_root):
+    path = os.path.join(repo_root, "LOADER_BENCH.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    out = {"unit": doc.get("unit")}
+    speedup = doc.get("schema_v2_speedup") or {}
+    out["schema_v2_over_v1"] = {
+        k: v.get("v2_over_v1") for k, v in speedup.items()
+        if isinstance(v, dict)
+    }
+    configs = doc.get("configs") or {}
+    out["sustained_samples_per_s"] = {
+        k: v.get("sustained_samples_per_s") for k, v in sorted(
+            configs.items()) if isinstance(v, dict)
+    }
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--repo-root",
+                    default=os.path.dirname(os.path.dirname(
+                        os.path.abspath(__file__))),
+                    help="directory holding the BENCH_r*.json artifacts")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable trajectory")
+    args = ap.parse_args(argv)
+    series = normalize(load_bench_series(args.repo_root))
+    result = {
+        "preprocess_mb_per_s": series,
+        "preprocess_verdict": verdict(series),
+        "loader": load_loader_bench(args.repo_root),
+    }
+    if args.json:
+        print(json.dumps(result, indent=2, sort_keys=True))
+        return 0
+    if not series:
+        print("no BENCH_r*.json artifacts under {}".format(args.repo_root))
+        return 0
+    rows = []
+    prev_norm = None
+    for r in series:
+        norm = r["normalized_mb_per_s"]
+        delta = ""
+        if norm is not None and prev_norm is not None:
+            delta = "{:+.1f}%".format((norm / prev_norm - 1.0) * 100.0)
+        rows.append([
+            r["round"],
+            "{:.2f}".format(r["mb_per_s"]),
+            "{:.3f}".format(r["calibration_s"])
+            if r["calibration_s"] is not None else "uncal",
+            "{:.2f}".format(norm) if norm is not None else "-",
+            delta,
+        ])
+        if norm is not None:
+            prev_norm = norm
+    print("preprocess headline trajectory (normalized to the newest "
+          "calibrated host):")
+    print(_table(rows, ["round", "MB/s raw", "cal_s", "MB/s norm",
+                        "delta"]))
+    v = result["preprocess_verdict"]
+    if v["delta_pct"] is not None:
+        print("verdict: {} ({:+.1f}% {} -> {}, calibration-normalized)"
+              .format(v["verdict"], v["delta_pct"], v["from_round"],
+                      v["to_round"]))
+    else:
+        print("verdict: {}".format(v["verdict"]))
+    loader = result["loader"]
+    if loader and loader["schema_v2_over_v1"]:
+        print("loader schema-v2 speedups: " + ", ".join(
+            "{}={}x".format(k, v) for k, v in sorted(
+                loader["schema_v2_over_v1"].items())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

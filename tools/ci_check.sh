@@ -36,6 +36,16 @@ else
     python -m compileall -q lddl_tpu tools benchmarks
 fi
 
+# Non-gating bench trajectory: a calibration-normalized regression/
+# improvement table over the committed BENCH_r*.json / LOADER_BENCH.json
+# series ("compare calibrations, not rounds"). Informational only: a
+# parse failure or a regression verdict must not fail the static gate.
+if python -m tools.bench_trajectory; then
+    :
+else
+    echo "ci_check: bench_trajectory FAILED (non-gating, ignored)" >&2
+fi
+
 # Non-gating loader health sample: a 1 MB v1-vs-v2 loader_bench smoke that
 # publishes LOADER_BENCH_SMOKE.json as a CI artifact. Opt-in via
 # LDDL_TPU_CI_SMOKE_BENCH=1 (it costs ~a minute of preprocessing, which

@@ -1,0 +1,204 @@
+"""Fleet/pipeline health monitor: one-shot report, --watch, or --json.
+
+Usage::
+
+    python -m tools.pipeline_status <dataset_or_output_dir>
+    python -m tools.pipeline_status <dir> --watch [--interval 5]
+    python -m tools.pipeline_status <dir> --json        # CI / benchmarks
+
+Reads the per-host telemetry spools under ``<dir>/.telemetry/`` (written
+by hosts running with ``LDDL_TPU_FLEET_DIR=<dir>`` or
+``--fleet-telemetry``; see lddl_tpu/observability/fleet.py) and renders
+cluster rollups with explicit health verdicts:
+
+- a host is **STALLED** when its last heartbeat is older than the stall
+  TTL (default: the lease TTL the host advertised) and it left no
+  clean-shutdown marker — the same condition under which the elastic
+  scheduler lets survivors steal the host's units;
+- the service is **WEDGED** when live hosts and pending work exist but
+  the journal/ledger has made no progress inside the wedge window.
+
+Exit status: 0 when healthy, 2 when any verdict fired (``--json`` too,
+so CI can gate on it). ``--merge-trace out.json`` additionally writes
+one clock-aligned Chrome trace spanning every host (open in Perfetto);
+``tools/trace_summary.py --merge`` does the same plus summary tables.
+
+All wall-clock reads happen inside ``fleet.aggregate`` (observability is
+the one layer allowlisted for them); this tool only formats the report.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+try:
+    from tools.trace_summary import _table  # python -m tools.*
+except ImportError:  # direct script invocation: tools/ is sys.path[0]
+    from trace_summary import _table
+
+
+def _fmt_age(age):
+    if age is None:
+        return "-"
+    if age < 120:
+        return "{:.1f}s".format(age)
+    if age < 7200:
+        return "{:.1f}m".format(age / 60.0)
+    return "{:.1f}h".format(age / 3600.0)
+
+
+def _fmt_rate(v, unit):
+    if v is None:
+        return "-"
+    return "{:.2f}{}".format(v, unit)
+
+
+def _host_status(st):
+    if st["stalled"]:
+        return "STALLED"
+    if st["closed"]:
+        return "closed"
+    return "live"
+
+
+def format_report(report):
+    out = []
+    health = report["health"]
+    out.append("pipeline status: {}".format(report["root"]))
+    out.append("overall: {}".format("OK" if health["ok"] else "UNHEALTHY"))
+    gen = report.get("journal_generation")
+    bits = []
+    if gen is not None:
+        bits.append("ingest journal at generation {}".format(gen))
+    if report.get("pending_work"):
+        bits.append("pending work: {}".format(report["pending_work"]))
+    if bits:
+        out.append("; ".join(bits))
+    hosts = report["hosts"]
+    if not hosts:
+        out.append("no telemetry spools found under {}/.telemetry/ — run "
+                   "hosts with --fleet-telemetry or LDDL_TPU_FLEET_DIR"
+                   .format(report["root"]))
+    else:
+        rows = []
+        for name in sorted(hosts):
+            st = hosts[name]
+            c = st["counters"]
+            rows.append([
+                name,
+                _host_status(st),
+                _fmt_age(st["heartbeat_age_s"]),
+                c["units_completed"],
+                c["steals"],
+                c["fence_rejects"],
+                c["retries"],
+                _fmt_rate(st["rates"].get("units_per_s"), "/s"),
+                _fmt_rate(st["rates"].get("mb_per_s"), ""),
+                st["torn_lines"] or "",
+            ])
+        totals = report["totals"]
+        rows.append([
+            "TOTAL", "", "",
+            totals["counters"]["units_completed"],
+            totals["counters"]["steals"],
+            totals["counters"]["fence_rejects"],
+            totals["counters"]["retries"],
+            _fmt_rate(totals["rates"].get("units_per_s"), "/s"),
+            _fmt_rate(totals["rates"].get("mb_per_s"), ""),
+            "",
+        ])
+        out.append("")
+        out.append(_table(rows, ["host", "state", "beat", "units",
+                                 "steals", "fenced", "retries", "units/s",
+                                 "MB/s", "torn"]))
+        gauge_rows = []
+        for name in sorted(hosts):
+            for key, val in sorted(hosts[name]["gauges"].items()):
+                gauge_rows.append([name, key,
+                                   "{:.4g}".format(val)
+                                   if isinstance(val, float) else val])
+        if gauge_rows:
+            out.append("")
+            out.append(_table(gauge_rows, ["host", "gauge", "value"]))
+        events = {}
+        for st in hosts.values():
+            for kind, n in st["event_counts"].items():
+                events[kind] = events.get(kind, 0) + n
+        if events:
+            out.append("")
+            out.append(_table(
+                [[k, n] for k, n in sorted(events.items(),
+                                           key=lambda kv: -kv[1])],
+                ["lifecycle event", "count"]))
+    out.append("")
+    if health["verdicts"]:
+        for v in health["verdicts"]:
+            out.append("!! {}".format(v))
+    else:
+        out.append("no health verdicts fired")
+    return "\n".join(out)
+
+
+def run_once(args):
+    from lddl_tpu.observability import fleet
+
+    report = fleet.aggregate(args.dir, stall_ttl=args.stall_ttl,
+                             wedge_window=args.wedge_window)
+    if args.merge_trace:
+        events, lanes = fleet.merge_traces(args.dir)
+        with open(args.merge_trace, "w", encoding="utf-8") as f:
+            json.dump(events, f)
+        report["merged_trace"] = {"path": args.merge_trace,
+                                  "events": len(events),
+                                  "lanes": ["{} pid{}".format(h, p)
+                                            for _, h, p in lanes]}
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=str))
+    else:
+        print(format_report(report))
+        if args.merge_trace:
+            print("merged trace: {} ({} events, {} lane(s))".format(
+                args.merge_trace, len(events), len(lanes)))
+    return 0 if report["health"]["ok"] else 2
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("dir", help="dataset/output dir containing .telemetry/")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report (exit 2 when "
+                         "unhealthy, same as the text mode)")
+    ap.add_argument("--watch", action="store_true",
+                    help="re-render the report every --interval seconds "
+                         "until interrupted")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="--watch refresh period")
+    ap.add_argument("--stall-ttl", type=float, default=None,
+                    help="heartbeat age (s) after which a non-closed host "
+                         "is declared stalled (default: the max TTL the "
+                         "hosts advertised, else 30)")
+    ap.add_argument("--wedge-window", type=float, default=None,
+                    help="no-progress window (s) after which live hosts "
+                         "with pending work are declared wedged "
+                         "(default: max(4*stall_ttl, 120))")
+    ap.add_argument("--merge-trace", default=None, metavar="OUT.json",
+                    help="also write one clock-aligned Chrome trace "
+                         "merging every host spool (open in Perfetto)")
+    args = ap.parse_args(argv)
+    if not args.watch:
+        return run_once(args)
+    try:
+        while True:
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            run_once(args)
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.2))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
