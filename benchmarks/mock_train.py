@@ -80,6 +80,16 @@ def attach_args():
     p.add_argument("--dp-rank", type=int, default=0)
     p.add_argument("--num-dp-groups", type=int, default=1)
     p.add_argument("--fixed-seq-lengths", type=int, nargs="*", default=None)
+    p.add_argument("--pack-seq-length", type=int, default=None,
+                   help="sequence packing row budget: on an UNPACKED dir "
+                        "this enables the greedy load-time packer (needs "
+                        "--pack-rows); offline-packed dirs are detected "
+                        "automatically and this only validates the budget")
+    p.add_argument("--pack-rows", type=int, default=None,
+                   help="packed rows per batch (load-time packer: "
+                        "required with --pack-seq-length; offline-packed "
+                        "dirs default to --batch-size)")
+    p.add_argument("--pack-max-per-row", type=int, default=8)
     p.add_argument("--seq-len-dir", default=None,
                    help="dump lens_<dp_rank>.npz here for validate_seqlen.py")
     p.add_argument("--debug", action="store_true")
@@ -212,6 +222,8 @@ def main():
     if args.metrics_dir:
         obs.configure(dir=args.metrics_dir, periodic=True)
 
+    offline_shape = None
+    packed = False
     if args.family == "bart":
         from lddl_tpu.loader.bart import get_bart_pretrain_data_loader
         if args.debug:
@@ -239,6 +251,12 @@ def main():
             on_corrupt=args.on_corrupt,
         )
     else:
+        # Packed mode: explicit flags (load-time packer on unpacked
+        # shards) or auto-detected offline-packed shards — either way the
+        # batch contract below is the packed one.
+        from lddl_tpu.loader.bert import packed_shape_of_dir
+        offline_shape = packed_shape_of_dir(args.path)
+        packed = args.pack_seq_length is not None or offline_shape
         loader = get_bert_pretrain_data_loader(
             args.path,
             dp_rank=args.dp_rank,
@@ -248,6 +266,9 @@ def main():
             worker_mode=args.worker_mode,
             vocab_file=args.vocab_file,
             fixed_seq_lengths=args.fixed_seq_lengths,
+            pack_seq_length=args.pack_seq_length,
+            pack_rows=args.pack_rows,
+            pack_max_per_row=args.pack_max_per_row,
             base_seed=args.seed,
             start_epoch=args.start_epoch,
             return_raw_samples=args.debug,
@@ -298,6 +319,29 @@ def main():
             state, _ = create_train_state(cfg, mesh, sample, model=model)
             step_fn = make_sharded_train_step(
                 mesh, cfg, model=model, batch_loss=bart_batch_loss)
+        elif packed:
+            # Packed batches (load-time or offline) feed the packed
+            # model: block-diagonal attention over segments, per-slot
+            # [CLS] pooling, [R, P] NSP labels.
+            from lddl_tpu.models.bert import BertForPreTrainingPacked
+            from lddl_tpu.models.testing import fake_packed_pretrain_batch
+            L = args.pack_seq_length or offline_shape[0]
+            P = (offline_shape[1] if offline_shape
+                 else args.pack_max_per_row)
+            rows = args.pack_rows or args.batch_size
+            make_cfg = (BertConfig.tiny if args.with_model == "tiny"
+                        else BertConfig.bert_base)
+            cfg_kw = dict(attention_impl=args.attention_impl,
+                          remat=args.remat)
+            if make_cfg(**cfg_kw).max_position_embeddings < L:
+                # Packed rows are L wide; size the position table to fit.
+                cfg_kw["max_position_embeddings"] = L
+            cfg = make_cfg(**cfg_kw)
+            model = BertForPreTrainingPacked(cfg)
+            sample = fake_packed_pretrain_batch(cfg.vocab_size, rows, L, P,
+                                                seed=args.seed)
+            state, _ = create_train_state(cfg, mesh, sample, model=model)
+            step_fn = make_sharded_train_step(mesh, cfg, model=model)
         else:
             cfg = (BertConfig.tiny if args.with_model == "tiny"
                    else BertConfig.bert_base)(
@@ -358,6 +402,13 @@ def main():
                 assert batch["labels"].shape == (n, L)
                 if args.family == "bart":
                     assert batch["decoder_input_ids"].shape == (n, L)
+                elif "segments" in batch:
+                    # Packed contract: per-token segment ids + per-slot
+                    # [CLS] columns / NSP labels.
+                    assert batch["segments"].shape == (n, L)
+                    assert batch["cls_positions"].shape == \
+                        batch["next_sentence_labels"].shape
+                    assert batch["next_sentence_labels"].shape[0] == n
                 else:
                     assert batch["token_type_ids"].shape == (n, L)
                     assert batch["next_sentence_labels"].shape == (n,)

@@ -435,8 +435,11 @@ class ManifestDeterminismRule(Rule):
 
     # Builder-name tokens this rule guards: manifest/ledger (PR 4) plus
     # the streaming-ingestion record builders (journal segments, intake
-    # records, generation meta).
-    NAME_TOKENS = ("manifest", "ledger", "journal", "intake", "generation")
+    # records, generation meta) and the offline packer's manifest-meta
+    # fragment (pack_meta_of — packed row shapes are resume-compared
+    # manifest content too).
+    NAME_TOKENS = ("manifest", "ledger", "journal", "intake", "generation",
+                   "pack_meta")
 
     def run(self, ctx):
         for node in ast.walk(ctx.tree):

@@ -148,6 +148,39 @@ def _generation_of_relpath(rel):
     return int(m.group(1)) if m else 0
 
 
+def _pack_shape_of_first(paths):
+    """Packed row shape of the first (sorted) shard, or None — one footer
+    read is enough: within one producer run the shape is schema-level
+    constant, and cross-run drift is what the caller refuses on."""
+    from ..preprocess.packing import pack_shape_of_parquet
+    for p in sorted(paths):
+        return pack_shape_of_parquet(p)
+    return None
+
+
+def _check_packed_shape(root, inputs, prior_bins):
+    """The delta balancer learns the packed row shape: delta part files
+    must be packed against the SAME (budget, max_per_row) the prior
+    generations fixed — mixing packed and unpacked rows (or two budgets)
+    in one directory would give the loader rows of two incompatible
+    shapes. The ingest fingerprint already freezes this for the service;
+    this guard catches manual misuse of the balancer API."""
+    in_paths = [p for paths in inputs.values() for p in paths]
+    prior_rels = [os.path.join(root, rel)
+                  for bins in prior_bins.values() for rel, _ in bins]
+    if not in_paths or not prior_rels:
+        return
+    delta_shape = _pack_shape_of_first(in_paths)
+    prior_shape = _pack_shape_of_first(prior_rels)
+    if delta_shape != prior_shape:
+        raise ValueError(
+            "delta and prior shards disagree on the packed row shape "
+            "(delta {}, prior {}); the ingest configuration drifted — "
+            "packed corpora must append deltas packed against the same "
+            "pack_seq_length/pack_max_per_row".format(
+                delta_shape or "unpacked", prior_shape or "unpacked"))
+
+
 def _prior_by_bin(prior):
     """{bin_id: [(relpath, count)]} from the prior snapshot, each bin's
     shards ordered by (generation, relpath) — so the deterministic
@@ -179,6 +212,7 @@ def stage_delta_balance(root, generation, part_paths, stage_dir, *,
     log = log or (lambda msg: None)
     inputs = _bin_inputs(part_paths, carry_in_paths)
     prior_bins = _prior_by_bin(prior)
+    _check_packed_shape(root, inputs, prior_bins)
     if inputs and prior_bins:
         in_binned = set(inputs) != {None}
         prior_binned = set(prior_bins) != {None}

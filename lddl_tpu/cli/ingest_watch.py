@@ -43,6 +43,14 @@ def attach_args(parser=None):
     parser.add_argument("--duplicate-factor", type=int, default=5)
     parser.add_argument("--seed", type=int, default=12345)
     parser.add_argument("--bin-size", type=int, default=None)
+    parser.add_argument("--pack-seq-length", type=int, default=None,
+                        help="grow an OFFLINE-PACKED corpus: every "
+                             "delta's instances are FFD-packed into "
+                             "fixed-budget schema-v2 rows (exclusive "
+                             "with --bin-size; the shape rides the "
+                             "journal fingerprint, so drift refuses)")
+    parser.add_argument("--pack-max-per-row", type=int, default=8,
+                        help="samples-per-row cap of the offline packer")
     parser.add_argument("--num-blocks", type=int, default=None,
                         help="blocks per delta preprocess (default: "
                              "scaled to the delta's document count)")
@@ -101,6 +109,8 @@ def main(args=None):
         num_blocks=args.num_blocks,
         num_workers=args.local_workers,
         flush_tail=args.flush_tail,
+        pack_seq_length=args.pack_seq_length,
+        pack_max_per_row=args.pack_max_per_row,
         **elastic_kwargs,
     )
     if args.once:

@@ -37,6 +37,15 @@ def attach_args(parser=None):
     parser.add_argument("--sample-ratio", type=float, default=0.9)
     parser.add_argument("--seed", type=int, default=12345)
     parser.add_argument("--bin-size", type=int, default=None)
+    parser.add_argument("--pack-seq-length", type=int, default=None,
+                        help="OFFLINE sequence packing: FFD-pack each "
+                             "bucket's instances into fixed-budget "
+                             "schema-v2 rows the loader streams zero-"
+                             "copy (exclusive with --bin-size; requires "
+                             "--schema-version 2)")
+    parser.add_argument("--pack-max-per-row", type=int, default=8,
+                        help="samples-per-row cap of the offline packer "
+                             "(the loader's cls_positions width)")
     parser.add_argument("--num-blocks", type=int, default=64)
     parser.add_argument("--spool-groups", type=int, default=None,
                         help="coarse radix width of the shuffle spool "
@@ -109,6 +118,8 @@ def main(args=None):
         sample_ratio=args.sample_ratio,
         seed=args.seed,
         bin_size=args.bin_size,
+        pack_seq_length=args.pack_seq_length,
+        pack_max_per_row=args.pack_max_per_row,
         global_shuffle=args.global_shuffle,
         output_format=args.output_format,
         comm=comm,

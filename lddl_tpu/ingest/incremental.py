@@ -166,6 +166,8 @@ def ingest_once(
     lease_ttl=30.0,
     holder_id=None,
     scatter_units=None,
+    pack_seq_length=None,
+    pack_max_per_row=8,
 ):
     """Diff the landing set against the journal and ingest the delta as
     one generation. Returns a report dict ({"noop": True} when there is
@@ -176,6 +178,12 @@ def ingest_once(
     (touches the minimum set of prior shards — see balance/delta.py)
     instead of deferring it; use it in maintenance windows, not while a
     loader is streaming the directory mid-epoch.
+
+    ``pack_seq_length`` grows packed corpora by generations: every
+    delta's instances are FFD-packed against the same budget the prior
+    generations fixed (the pack shape rides the processor fingerprint,
+    so drift refuses like any other config drift), and carry/remainder
+    semantics are untouched — carryover rows are whole packed rows.
     """
     log = log or (lambda msg: None)
     # Long-lived service: heartbeats must run even on noop rounds so the
@@ -186,13 +194,15 @@ def ingest_once(
         return _ingest_once_body(
             root, tokenizer, landing, files, config, num_shards, bin_size,
             seed, num_blocks, num_workers, flush_tail, comm, log, elastic,
-            lease_ttl, holder_id, scatter_units)
+            lease_ttl, holder_id, scatter_units, pack_seq_length,
+            pack_max_per_row)
 
 
 def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
                       bin_size, seed, num_blocks, num_workers, flush_tail,
                       comm, log, elastic, lease_ttl, holder_id,
-                      scatter_units):
+                      scatter_units, pack_seq_length=None,
+                      pack_max_per_row=8):
     from ..preprocess.bert import BertPretrainConfig
     from ..preprocess.runner import BertBucketProcessor, run_bert_preprocess
 
@@ -206,7 +216,9 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
     os.makedirs(root, exist_ok=True)
     journal = journal_mod.Journal.load(root)
     fingerprint = BertBucketProcessor(
-        tokenizer, config, seed, root, bin_size, "parquet").fingerprint()
+        tokenizer, config, seed, root, bin_size, "parquet",
+        pack_seq_length=pack_seq_length,
+        pack_max_per_row=pack_max_per_row).fingerprint()
     if journal.fingerprint is not None \
             and journal.fingerprint != fingerprint:
         raise ValueError(
@@ -289,6 +301,9 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
             "seed": int(seed),
             "bin_size": bin_size,
             "flush": bool(flush_tail),
+            "pack_seq_length": (int(pack_seq_length)
+                                if pack_seq_length else None),
+            "pack_max_per_row": int(pack_max_per_row),
         }
         journal_mod.publish_record(
             journal_mod.intake_path(root, generation), intake)
@@ -323,6 +338,10 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
                 holder_id=holder_id,
                 scatter_units=scatter_units,
                 emit_manifest=False,
+                # A resumed generation replays its FROZEN intake record
+                # (legacy records carry no pack keys: unpacked).
+                pack_seq_length=intake.get("pack_seq_length"),
+                pack_max_per_row=intake.get("pack_max_per_row", 8),
             )
         part_paths = get_all_parquets_under(pre_dir)
         obs.fleet.record("generation.preprocess", generation=generation,

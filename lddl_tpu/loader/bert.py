@@ -49,6 +49,32 @@ def generation_gate_filter(root, paths):
     return paths, gate
 
 
+def packed_shape_of_dir(path, file_paths=None):
+    """(pack_seq_length, pack_max_per_row) of an offline-packed shard
+    directory, or None. The root ``.manifest.json``'s ``__meta__.packed``
+    entry (written by build_manifest off every shard's footer) is
+    authoritative; manifest-less or meta-less directories sniff one
+    shard's footer metadata instead — detection must work for raw
+    preprocess output too, not only published datasets."""
+    from ..resilience.integrity import read_manifest
+    manifest = read_manifest(path)
+    meta = (manifest.get("__meta__") if manifest else None) or {}
+    packed = meta.get("packed")
+    if isinstance(packed, dict):
+        try:
+            return (int(packed["pack_seq_length"]),
+                    int(packed["pack_max_per_row"]))
+        except (KeyError, TypeError, ValueError):
+            return None
+    if file_paths is None:
+        from ..utils.fs import get_all_parquets_under
+        file_paths = get_all_parquets_under(path)
+    if file_paths:
+        from ..preprocess.packing import pack_shape_of_parquet
+        return pack_shape_of_parquet(sorted(file_paths)[0])
+    return None
+
+
 class GenerationSnapshot:
     """One gate + directory-listing read shared by every bin's follower
     within one epoch boundary (keyed by the boundary's epoch number), so
@@ -138,17 +164,133 @@ def _decode_columnar(b, names):
                    flat_b[off_b[i]:off_b[i + 1]], rn[i])
 
 
+class PackedRow(tuple):
+    """One offline-packed shard row decoded to views:
+    ``(ids, tok3, content, samp, mlm2)``. ``ids`` is the stored
+    fully-interleaved row content ([CLS]/[SEP] baked in at pack time);
+    ``tok3`` stacks the boundary-derived per-token arrays
+    ``[segments, position_ids, token_type]`` and ``samp`` the per-sample
+    arrays ``[a_lens, b_lens, off, nsp(, mask_lens)]`` — both
+    precomputed ONCE per decode chunk, stacked so a row is FIVE slices
+    and a batch is four axis-1 concatenates; ``content`` is the
+    content-vs-special bool mask (dynamic masking), ``mlm2`` stacks
+    ``[positions, labels]`` (ROW-relative positions as stored; None for
+    dynamic-masking corpora). The named properties unpack the stacks. A
+    distinct type (not a bare tuple) so collates can assert they were
+    wired to the decode path they expect."""
+
+    __slots__ = ()
+
+    ids = property(lambda s: s[0])
+    seg = property(lambda s: s[1][0])
+    pos = property(lambda s: s[1][1])
+    typ = property(lambda s: s[1][2])
+    content = property(lambda s: s[2])
+    a_lens = property(lambda s: s[3][0])
+    b_lens = property(lambda s: s[3][1])
+    off = property(lambda s: s[3][2])
+    nsp = property(lambda s: s[3][3])
+    mask_lens = property(lambda s: s[3][4] if len(s[3]) > 4 else None)
+    mlm_pos = property(lambda s: s[4][0] if s[4] is not None else None)
+    mlm_labels = property(lambda s: s[4][1] if s[4] is not None else None)
+
+
+# Decode-chunk token budget: the boundary-derived per-token arrays are
+# materialized per chunk (not per shard-sized record batch — row groups
+# can span a whole shard), so buffered rows keep at most
+# ~shuffle_buffer_size + chunk rows' worth of derived arrays alive.
+_DECODE_CHUNK_TOKENS = 1 << 20
+
+
+def _decode_prepacked(b, names):
+    """Offline-packed fast path: one zero-copy buffer grab per column,
+    the boundary-derived per-token arrays computed ONCE per chunk of
+    rows (vectorized over thousands of rows, amortizing numpy dispatch),
+    then per-ROW views — each yielded sample is one already-packed
+    training row; no repacking and no per-sample work ever happens at
+    load time."""
+    ids_v, ids_off = _list_views(b.column("input_ids"))
+    al_v, al_off = _list_views(b.column("pack_a_lens"))
+    nsp_v, _ = _list_views(b.column("pack_nsp"))
+    bl_v, _ = _list_views(b.column("pack_b_lens"))
+    static = "pack_mask_lens" in names
+    if static:
+        pos_v, pos_off = _list_views(b.column("masked_lm_positions_ids"))
+        lab_v, _ = _list_views(b.column("masked_lm_label_ids"))
+        ml_v, _ = _list_views(b.column("pack_mask_lens"))
+    n = b.num_rows
+    row = 0
+    aranges = BertCollate._concat_aranges
+    while row < n:
+        end = row + 1
+        while end < n and ids_off[end + 1] - ids_off[row] \
+                <= _DECODE_CHUNK_TOKENS:
+            end += 1
+        # Sample-flat slices for rows [row, end); all derived arrays are
+        # chunk-relative, computed in one vectorized pass, and STACKED
+        # (per-token x3, per-sample x4|5, mlm x2) so each row is five
+        # slices and a batch is four axis-1 concatenates.
+        s0, s1 = int(al_off[row]), int(al_off[end])
+        al = al_v[s0:s1].astype(np.int64)
+        bl = bl_v[s0:s1].astype(np.int64)
+        spr = (al_off[row:end + 1] - s0).astype(np.int64)
+        samples_per_row = np.diff(spr)
+        tot = al + bl + 3
+        slot = aranges(samples_per_row)
+        pos64 = aranges(tot)
+        tok3 = np.empty((3, len(pos64)), dtype=np.int32)
+        tok3[0] = np.repeat(slot + 1, tot)                  # segments
+        tok3[1] = pos64                                     # position_ids
+        tok3[2] = pos64 >= np.repeat(2 + al, tot)           # token_type
+        content = ((pos64 != 0)
+                   & (pos64 != np.repeat(1 + al, tot))
+                   & (pos64 != np.repeat(tot - 1, tot)))
+        cum = np.cumsum(tot) - tot              # token start per sample
+        samp = np.empty((5 if static else 4, len(al)), dtype=np.int32)
+        samp[0] = al_v[s0:s1]
+        samp[1] = bl_v[s0:s1]
+        samp[2] = cum - np.repeat(cum[spr[:-1]], samples_per_row)
+        samp[3] = nsp_v[s0:s1]
+        if static:
+            samp[4] = ml_v[s0:s1]
+            m0 = int(pos_off[row])
+            mlm2 = np.empty((2, int(pos_off[end]) - m0), dtype=np.int32)
+            mlm2[0] = pos_v[m0:int(pos_off[end])]
+            mlm2[1] = lab_v[m0:int(pos_off[end])]
+        # Slice bounds as plain ints, materialized once per chunk (a few
+        # per ROW, never per token): numpy scalar extraction inside the
+        # per-row loop costs ~10x a list index.
+        idsb = ids_off[row:end + 1].tolist()  # lddl: disable=python-hot-loop
+        trow = (ids_off[row:end + 1] - ids_off[row]).tolist()  # lddl: disable=python-hot-loop
+        sprl = spr.tolist()  # lddl: disable=python-hot-loop
+        if static:
+            mb = (pos_off[row:end + 1] - m0).tolist()  # lddl: disable=python-hot-loop
+        for i in range(end - row):
+            mlm = mlm2[:, mb[i]:mb[i + 1]] if static else None
+            yield PackedRow((
+                ids_v[idsb[i]:idsb[i + 1]],
+                tok3[:, trow[i]:trow[i + 1]], content[trow[i]:trow[i + 1]],
+                samp[:, sprl[i]:sprl[i + 1]], mlm))
+        row = end
+
+
 def decode_record_batch(b):
     """Yield sample tuples from a parquet RecordBatch:
     (A, B, is_random_next[, masked_lm_positions, masked_lm_labels]).
 
     Schema v2 shards (``A_ids`` present) decode to int32 ndarray views
     over the batch's flat token-id buffers; schema v1 decodes to the
-    original Python strings. Selection is per-shard, so directories mixing
-    both schemas stream correctly (and byte-identically — the collate
-    normalizes)."""
+    original Python strings. Offline-packed shards (``pack_a_lens``
+    present) decode one PackedRow of views per parquet row. Selection is
+    per-shard, so directories mixing both schemas stream correctly (and
+    byte-identically — the collate normalizes)."""
     from .. import observability as obs
     names = b.schema.names
+    if "pack_a_lens" in names:
+        if obs.enabled():
+            obs.inc("loader_decode_packed_batches_total")
+        yield from _decode_prepacked(b, names)
+        return
     if "A_ids" in names:
         if obs.enabled():
             obs.inc("loader_decode_columnar_batches_total")
@@ -366,8 +508,15 @@ class BertPackedCollate(BertCollate):
         self._max_per_row = pack_max_per_row
 
     def __call__(self, layout_rows, samples, g=None):
+        return self._encode_packed(layout_rows, samples, g, self._rows)
+
+    def _encode_packed(self, layout_rows, samples, g, R):
+        """The packed scatter encode for ``R`` output rows — shared by the
+        load-time packer (R = configured pack_rows) and the offline
+        prepacked collate (R = rows in this batch). ``R`` is a parameter,
+        not instance state: collates are shared across worker threads."""
         from ..ops.packing import packed_layout_arrays
-        L, R, P = self._fixed_seq_length, self._rows, self._max_per_row
+        L, P = self._fixed_seq_length, self._max_per_row
         n = len(samples)
         static = len(samples[0]) == 5
         layout = packed_layout_arrays(layout_rows, L, P)
@@ -445,6 +594,96 @@ class BertPackedCollate(BertCollate):
                                    + (R - layout["n_rows"]) * L),
                  "total_tokens": R * L, "n_samples": n}
         return batch, stats
+
+
+class BertPrepackedCollate(BertPackedCollate):
+    """Collate for OFFLINE-packed shards: each input sample is one
+    pre-packed row (a PackedRow of zero-copy views from
+    decode_record_batch). The FFD packing happened at preprocess time,
+    so this encode is FULLY vectorized: the per-row flat buffers
+    concatenate once per column (R arrays, not one per sample) and every
+    layout quantity — row/slot/offset per sample — derives from the
+    stored boundary columns with numpy arithmetic; no per-sample Python
+    exists anywhere on the path. Batches are exactly
+    ``len(rows) x pack_seq_length``; a full batch always has the one
+    static shape, like the load-time packer's output."""
+
+    def __init__(self, tokenizer, pack_seq_length, pack_max_per_row,
+                 ignore_index=-1, mlm_prob=0.15, emit_loss_mask=False):
+        super().__init__(tokenizer, pack_seq_length, pack_rows=1,
+                         pack_max_per_row=pack_max_per_row,
+                         ignore_index=ignore_index, mlm_prob=mlm_prob,
+                         emit_loss_mask=emit_loss_mask)
+
+    def __call__(self, rows, g=None):
+        if not rows or not isinstance(rows[0], PackedRow):
+            raise TypeError(
+                "BertPrepackedCollate consumes PackedRow samples from "
+                "offline-packed shards; got {} — is this directory "
+                "actually packed?".format(type(rows[0]).__name__
+                                          if rows else "an empty batch"))
+        static = rows[0][4] is not None
+        L, P, R = self._fixed_seq_length, self._max_per_row, len(rows)
+
+        # Four axis-1 concatenates over R precomputed row views, one
+        # index build, then one flat scatter per output array.
+        ids_rows = [r[0] for r in rows]
+        used = np.fromiter(map(len, ids_rows), dtype=np.int64, count=R)
+        bases = np.arange(R, dtype=np.int64) * L
+        idx_all = np.repeat(bases, used) + self._concat_aranges(used)
+        tok3 = np.concatenate([r[1] for r in rows], axis=1)
+        samp = np.concatenate([r[3] for r in rows], axis=1)
+
+        input_ids = np.zeros((R, L), dtype=np.int32)
+        input_ids.flat[idx_all] = np.concatenate(ids_rows)
+        # The three per-token planes land in ONE fancy-index assignment
+        # (their batch dict entries are views of one backing array), and
+        # the attention mask needs no scatter at all: packed rows fill a
+        # PREFIX of each row, so it is a broadcast compare against the
+        # per-row used count.
+        out3 = np.zeros((3, R, L), dtype=np.int32)
+        out3.reshape(3, R * L)[:, idx_all] = tok3
+        segments, position_ids, token_type_ids = out3
+        attention_mask = (np.arange(L, dtype=np.int64)[None, :]
+                          < used[:, None]).astype(np.int32)
+
+        samples_per_row = np.fromiter(
+            (r[3].shape[1] for r in rows), dtype=np.int64, count=R)
+        row_of = np.repeat(np.arange(R, dtype=np.int64), samples_per_row)
+        slot_of = self._concat_aranges(samples_per_row)
+        cls_positions = np.zeros((R, P), dtype=np.int32)
+        nsp = np.full((R, P), self._ignore_index, dtype=np.int32)
+        cls_positions[row_of, slot_of] = samp[2]
+        nsp[row_of, slot_of] = samp[3]
+
+        labels = np.full((R, L), self._ignore_index, dtype=np.int32)
+        if static:
+            mlm2 = np.concatenate([r[4] for r in rows], axis=1)
+            mask_counts = np.fromiter(
+                (r[4].shape[1] for r in rows), dtype=np.int64, count=R)
+            labels.flat[np.repeat(bases, mask_counts) + mlm2[0]] = mlm2[1]
+        else:
+            if g is None:
+                raise ValueError("dynamic masking needs a worker RNG")
+            special = np.ones((R, L), dtype=bool)
+            special.flat[idx_all] = ~np.concatenate(
+                [r[2] for r in rows])
+            input_ids, labels = self._mask_tokens(input_ids, special, g)
+
+        batch = {
+            "input_ids": input_ids,
+            "token_type_ids": token_type_ids,
+            "attention_mask": attention_mask,
+            "segments": segments,
+            "position_ids": position_ids,
+            "cls_positions": cls_positions,
+            "next_sentence_labels": nsp,
+            "labels": labels,
+        }
+        if self._emit_loss_mask:
+            batch["loss_mask"] = (labels != self._ignore_index).astype(
+                np.int32)
+        return batch
 
 
 class PackedBertLoader:
@@ -635,6 +874,16 @@ def get_bert_pretrain_data_loader(
     models.BertForPreTrainingPacked. Packing subsumes binning (every row
     is exactly pack_seq_length wide), so it requires unbinned shards.
 
+    **Offline-packed directories** (preprocessed with
+    ``pack_seq_length=...`` — see preprocess/packing.py) are detected
+    automatically from the manifest's ``__meta__.packed`` entry (or a
+    footer sniff) and stream their pre-packed rows zero-copy: no load-
+    time packing runs at all, the stored row shape is authoritative
+    (``pack_seq_length``, if passed, must match; ``pack_rows`` — default
+    ``batch_size`` — sets rows per batch), and the batch contract is the
+    packed one above. The greedy load-time packer remains the fallback
+    for unpacked directories.
+
     ``dp_rank``/``num_dp_groups`` name the data-parallel group of this
     process — derive them from a device mesh with
     ``lddl_tpu.loader.process_dp_info(mesh)``. All processes in the same
@@ -670,6 +919,67 @@ def get_bert_pretrain_data_loader(
             # corrupt shards just logged, not at the preprocessor.
             raise annotate_quarantine(e, n_quarantined) from e
         raise
+
+    packed_shape = packed_shape_of_dir(path, file_paths)
+    if packed_shape is not None:
+        # OFFLINE-packed directory: every parquet row is an already-
+        # packed training row, so the loader is a plain zero-copy row
+        # stream + scatter encode — the greedy load-time pack loop below
+        # never runs (it stays only as the fallback for unpacked dirs).
+        L, P = packed_shape
+        if pack_seq_length is not None and int(pack_seq_length) != L:
+            raise ValueError(
+                "shards under {} were packed offline at pack_seq_length="
+                "{}, which the stored rows fix; requested {}".format(
+                    path, L, pack_seq_length))
+        if bin_ids:
+            raise ValueError("offline-packed shards cannot be binned")
+        if return_raw_samples:
+            raise ValueError(
+                "return_raw_samples over offline-packed shards is not "
+                "supported (rows are packed training rows, not samples)")
+        if fixed_seq_lengths is not None:
+            raise ValueError(
+                "offline-packed shards fix the row width at {}; "
+                "fixed_seq_lengths does not apply".format(L))
+        # Rows per batch: pack_rows when given (API parity with the
+        # load-time packer), else the ordinary batch_size. Unlike the
+        # stream packer, batch COUNTS are row-arithmetic (balanced ±1
+        # shards), so multi-dp-group epochs stay lockstep — no
+        # pack_allow_uneven_epochs needed.
+        rows = int(pack_rows) if pack_rows is not None else int(batch_size)
+        gen_snapshot = GenerationSnapshot(path) if follow_generations \
+            else None
+        try:
+            dataset = ParquetDataset(
+                file_paths,
+                base_seed=base_seed,
+                start_epoch=start_epoch,
+                dp_rank=dp_rank,
+                num_dp_groups=num_dp_groups,
+                num_workers=num_workers,
+                shuffle_buffer_size=shuffle_buffer_size,
+                shuffle_buffer_warmup_factor=shuffle_buffer_warmup_factor,
+                decode_record_batch=decode_record_batch,
+                comm=comm,
+                logger=logger,
+                refresh=(GenerationFollower(path, on_corrupt=on_corrupt,
+                                            snapshot=gen_snapshot)
+                         if follow_generations else None),
+            )
+        except ValueError as e:
+            if n_quarantined:
+                raise annotate_quarantine(e, n_quarantined) from e
+            raise
+        return DataLoader(
+            dataset,
+            rows,
+            collate_fn=BertPrepackedCollate(
+                tokenizer, L, P, ignore_index=ignore_index,
+                mlm_prob=mlm_prob, emit_loss_mask=emit_loss_mask),
+            prefetch=prefetch,
+            worker_mode=worker_mode,
+        )
 
     packing = pack_seq_length is not None or pack_rows is not None
     if packing:

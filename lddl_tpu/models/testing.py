@@ -25,6 +25,41 @@ def fake_pretrain_batch(vocab_size, batch, seq_len, seed=0,
     }
 
 
+def fake_packed_pretrain_batch(vocab_size, rows, seq_len, max_per_row,
+                               seed=0):
+    """Synthetic batch matching the PACKED loader contract
+    (loader/bert.BertPackedCollate / BertPrepackedCollate output): two
+    samples per row (one when ``max_per_row`` is 1), block-diagonal
+    segments, per-slot NSP labels padded with -1 — param-init shape/key
+    fodder for BertForPreTrainingPacked."""
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(5, vocab_size, (rows, seq_len)).astype(np.int32)
+    n_samples = min(2, max_per_row)
+    half = seq_len // 2 if n_samples == 2 else seq_len
+    segments = np.ones((rows, seq_len), np.int32)
+    segments[:, half:] = n_samples
+    position_ids = np.concatenate(
+        [np.arange(half), np.arange(seq_len - half)]).astype(np.int32)
+    position_ids = np.broadcast_to(position_ids, (rows, seq_len)).copy()
+    cls_positions = np.zeros((rows, max_per_row), np.int32)
+    if n_samples == 2:
+        cls_positions[:, 1] = half
+    nsp = np.full((rows, max_per_row), -1, np.int32)
+    nsp[:, :n_samples] = rng.integers(0, 2,
+                                      (rows, n_samples)).astype(np.int32)
+    return {
+        "input_ids": ids,
+        "token_type_ids": np.zeros((rows, seq_len), np.int32),
+        "attention_mask": np.ones((rows, seq_len), np.int32),
+        "segments": segments,
+        "position_ids": position_ids,
+        "cls_positions": cls_positions,
+        "next_sentence_labels": nsp,
+        "labels": np.where(rng.random((rows, seq_len)) < 0.15, ids,
+                           -1).astype(np.int32),
+    }
+
+
 def fake_bart_batch(vocab_size, batch, seq_len, seed=0):
     """Synthetic batch matching the BART loader contract
     (loader/bart.py: input_ids/attention_mask/decoder_input_ids/labels)."""

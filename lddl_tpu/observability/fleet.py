@@ -478,6 +478,8 @@ ROLLUP_COUNTERS = (
     ("docs", "preprocess_docs_total"),
     ("doc_bytes", "preprocess_doc_bytes_total"),
     ("samples", "preprocess_samples_total"),
+    ("pack_tokens_placed", "preprocess_pack_tokens_total"),
+    ("pack_slot_tokens", "preprocess_pack_slot_tokens_total"),
     ("ingest_docs", "ingest_docs_total"),
     ("generations_published", "ingest_generations_published_total"),
     ("loader_batches", "loader_batches_total"),
@@ -492,6 +494,7 @@ ROLLUP_GAUGES = (
     ("ingest_backlog_docs", "ingest_backlog_docs"),
     ("ingest_carry_rows", "ingest_carry_rows"),
     ("samples_per_second", "preprocess_samples_per_second"),
+    ("pack_fill_ratio", "preprocess_pack_fill_ratio"),
 )
 
 
@@ -524,6 +527,12 @@ def _host_rollup(spool, now, stall_ttl):
                             for s in snaps) if v is not None]
         if vals:
             gauges[key] = max(vals)
+    if counters["pack_slot_tokens"]:
+        # Recompute the host's pack fill from its counter totals (summed
+        # over pids) so the host row and the per-pid gauge agree even
+        # when several worker processes each packed a slice.
+        gauges["pack_fill_ratio"] = (counters["pack_tokens_placed"]
+                                     / counters["pack_slot_tokens"])
     stamps = [s.get("wall", 0.0) for s in snaps]
     stamps.extend(ev.get("wall", 0.0) for ev in spool["events"][-1:])
     last_wall = max(stamps) if stamps else None
@@ -629,6 +638,12 @@ def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
         hosts[h] = _host_rollup(load_spool(root, h, warn), now, stall_ttl)
     totals = {key: sum(h["counters"][key] for h in hosts.values())
               for key, _ in ROLLUP_COUNTERS}
+    if totals.get("pack_slot_tokens"):
+        # Cluster-wide offline-pack fill: recomputed from the summed
+        # counters (a mean of per-host ratios would weight hosts, not
+        # tokens).
+        totals["pack_fill_ratio"] = (totals["pack_tokens_placed"]
+                                     / totals["pack_slot_tokens"])
     total_rates = {}
     for key in ("units_per_s", "mb_per_s", "samples_per_s"):
         vals = [h["rates"].get(key) for h in hosts.values()

@@ -112,6 +112,21 @@ def int32_list_array(flat_vals, row_lens):
                                  children=[child])
 
 
+def gather_list_slices(values, lens, order):
+    """Re-gather a flat-values + per-row-lens list column into a new row
+    ``order``: returns ``(values_in_order, lens_in_order)`` where row
+    ``order[i]``'s slice lands contiguously at position ``i``. One fancy
+    index over the flat buffer — the offline packer's column permutation
+    (no per-row Python, no intermediate list objects)."""
+    values = np.asarray(values)
+    lens = np.asarray(lens, dtype=np.int64)
+    order = np.asarray(order, dtype=np.int64)
+    starts = np.cumsum(lens) - lens
+    sel = lens[order]
+    src = np.repeat(starts[order], sel) + concat_aranges(sel)
+    return values[src], sel
+
+
 _U16_HEADER = np.frombuffer(b"R<u2", dtype=np.uint8)
 
 

@@ -44,8 +44,33 @@ MASKING_TOKEN_ID_SCHEMA = {
     "masked_lm_positions_ids": pa.list_(pa.int32()),
     "masked_lm_label_ids": pa.list_(pa.int32()),
 }
-# Column names whose presence marks a schema-v2 shard (BERT / BART).
-SCHEMA_V2_MARKERS = ("A_ids", "sentence_ids")
+# Column names whose presence marks a schema-v2 shard (BERT / BART /
+# offline-packed — packed shards are inherently id-columnar).
+SCHEMA_V2_MARKERS = ("A_ids", "sentence_ids", "pack_a_lens")
+
+# Offline-packed shards (preprocess/packing.py): every parquet row is one
+# already-packed fixed-token-budget training row. ``input_ids`` stores
+# the FULL interleaved content — [CLS] A [SEP] B [SEP] per sample,
+# specials baked in at pack time — and the boundary columns carry
+# per-sample segment lengths so the loader (and the model's
+# block-diagonal attention masking) reconstructs per-sample segment ids
+# without repacking or any tokenizer knowledge. Masking positions are
+# stored ROW-relative. Packed shards are inherently schema v2 (id
+# columns only — per-sample text columns have no row-level meaning).
+PACKED_BASE_SCHEMA = {
+    "input_ids": pa.list_(pa.int32()),
+    "pack_a_lens": pa.list_(pa.int32()),
+    "pack_b_lens": pa.list_(pa.int32()),
+    "pack_nsp": pa.list_(pa.int32()),
+    "num_tokens": pa.uint16(),
+}
+PACKED_MASKING_SCHEMA = {
+    "masked_lm_positions_ids": pa.list_(pa.int32()),
+    "masked_lm_label_ids": pa.list_(pa.int32()),
+    "pack_mask_lens": pa.list_(pa.int32()),
+}
+# Column whose presence marks an offline-packed shard.
+PACKED_MARKER = "pack_a_lens"
 
 
 def schema_version_of_names(names):
@@ -71,6 +96,24 @@ def bin_id_of_num_tokens(num_tokens, bin_size, nbins):
     return np.minimum(np.maximum(num_tokens - 1, 0) // bin_size, nbins - 1)
 
 
+def make_packed_schema(masking=False, pack_seq_length=None,
+                       max_per_row=None):
+    """Schema of an offline-packed shard; the row shape is stamped into
+    the schema metadata so it survives the balancer's row-wise
+    concat/slice and the manifest can record it without guessing."""
+    from .packing import PACK_META_MAX_PER_ROW, PACK_META_SEQ_LENGTH
+    fields = dict(PACKED_BASE_SCHEMA)
+    if masking:
+        fields.update(PACKED_MASKING_SCHEMA)
+    metadata = None
+    if pack_seq_length is not None:
+        metadata = {
+            PACK_META_SEQ_LENGTH: str(int(pack_seq_length)).encode(),
+            PACK_META_MAX_PER_ROW: str(int(max_per_row or 8)).encode(),
+        }
+    return pa.schema(list(fields.items()), metadata=metadata)
+
+
 def make_schema(masking=False, binned=False, token_ids=False):
     fields = dict(BASE_SCHEMA)
     if masking:
@@ -92,15 +135,32 @@ DEFAULT_PARQUET_COMPRESSION = "lz4"
 
 def write_shard_columns(columns, n, out_dir, part_id, masking=False,
                         bin_size=None, target_seq_length=128,
-                        compression=DEFAULT_PARQUET_COMPRESSION):
+                        compression=DEFAULT_PARQUET_COMPRESSION,
+                        pack_seq_length=None, pack_max_per_row=8,
+                        pack_special_ids=None):
     """Write one block's COLUMNS ({name: list-or-ndarray}) as
     part.<part_id>.parquet[_<bin>] files — the columnar fast path (no
     per-row dicts anywhere between sample construction and arrow).
 
     Returns {written_path: num_rows}. With binning enabled, only non-empty
     bins produce a file (ref: binning.py:353-431); the balancer later
-    equalizes the global per-bin file sets.
+    equalizes the global per-bin file sets. With ``pack_seq_length`` set
+    (mutually exclusive with binning — packing subsumes it), the sink
+    first-fit-decreasing-packs the bucket into fixed-budget rows and the
+    row count IS the packed row count (preprocess/packing.py).
     """
+    if pack_seq_length is not None:
+        if bin_size is not None:
+            raise ValueError("pack_seq_length and bin_size are exclusive "
+                             "(packing subsumes binning)")
+        if pack_special_ids is None:
+            raise ValueError("the packed sink needs pack_special_ids="
+                             "(cls_id, sep_id) to interleave row content")
+        from .packing import write_packed_shard
+        return write_packed_shard(columns, n, out_dir, part_id,
+                                  pack_seq_length, pack_max_per_row,
+                                  pack_special_ids[0], pack_special_ids[1],
+                                  masking=masking, compression=compression)
     os.makedirs(out_dir, exist_ok=True)
     written = {}
     token_ids = "A_ids" in columns  # schema v2 sniffed off the columns

@@ -1,0 +1,293 @@
+"""Offline corpus-level sequence packing: the preprocess-stage FFD sink.
+
+The load-time packer (loader/bert.PackedBertLoader + ops/packing.
+StreamPacker) repacks every epoch with a streaming first-fit — correct,
+but it makes the packed path the SLOWEST loader configuration
+(LOADER_BENCH.json: 29.8k samples/s packed vs 70.4k unbinned v2) because
+per-sample Python packing runs on the hot path. This module moves the
+packing OFFLINE: the preprocess sink sorts each bucket's instance
+lengths descending and first-fit-decreasing-packs them into
+fixed-token-budget rows, emitting schema-v2 shards whose parquet rows
+ARE already-packed training rows. The loader then streams rows zero-copy
+through the ordinary schema-v2 decode path (loader/bert.
+BertPrepackedCollate) — no Python-side repacking at all, and pad_ratio
+is the corpus-level FFD fill, at or below what the streaming packer
+achieves.
+
+Packed row schema (all id columns; packed shards are inherently
+schema v2 — see binning.PACKED_BASE_SCHEMA):
+
+    input_ids                  list<int32>  the row's FULL interleaved
+                                            content: [CLS] A [SEP] B
+                                            [SEP] per sample, specials
+                                            baked in at pack time
+    pack_a_lens / pack_b_lens  list<int32>  per-sample boundary columns
+    pack_nsp                   list<int32>  per-sample is_random_next
+    num_tokens                 uint16       used tokens in the row
+    masked_lm_positions_ids    list<int32>  (static masking) ROW-relative
+    masked_lm_label_ids        list<int32>  positions / label ids, concat
+    pack_mask_lens             list<int32>  per-sample masking counts
+
+The boundary columns let the loader (and the model's block-diagonal
+attention masking) reconstruct per-sample segment ids without touching
+token bytes or knowing the tokenizer, and the interleaved ``input_ids``
+content means loading a row is one prefix scatter — no per-sample
+assembly. The row shape ``(pack_seq_length, pack_max_per_row)`` is
+stamped into the parquet schema metadata (PACK_META_* keys) so the
+balancer's row-wise concat/slice carries it along and the manifest's
+``__meta__`` can record it without guessing.
+
+Determinism: FFD is pure sorting + first-fit (no RNG, no clock, no FS
+order — lengths arrive in the bucket's canonical keyed-shuffle order and
+ties break on that position), so packed shard bytes satisfy the same
+resume/manifest invariants as every other sink; the pack parameters ride
+the processor resume fingerprint.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+
+from .. import observability as obs
+from ..resilience.io import write_table_atomic
+from .arrowcols import gather_list_slices, int32_list_array
+
+# Parquet schema-metadata keys stamping the packed row shape into every
+# packed shard (strings; read back by pack_shape_of_schema).
+PACK_META_SEQ_LENGTH = b"lddl_pack_seq_length"
+PACK_META_MAX_PER_ROW = b"lddl_pack_max_per_row"
+
+
+def ffd_pack(lengths, budget, max_per_row):
+    """First-fit-decreasing bin packing of ``lengths`` into rows of
+    capacity ``budget`` holding at most ``max_per_row`` samples.
+
+    Deterministic: samples are visited in (length desc, original index)
+    order and each drops into the FIRST open row with room (rows in
+    creation order). Returns ``(sample_order, samples_per_row)`` —
+    ``sample_order`` concatenates every row's sample indices in placement
+    order, ``samples_per_row[r]`` counts row ``r``'s samples — the exact
+    gather plan pack_columns consumes.
+
+    The inner "first row that fits" scan is one vectorized numpy mask per
+    sample (O(rows) bytes, not O(rows) Python), which keeps even a
+    many-thousand-row bucket well under preprocess noise offline.
+    """
+    lengths = np.asarray(lengths, dtype=np.int64)
+    n = len(lengths)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64), np.zeros(0, dtype=np.int64)
+    if int(lengths.max()) > budget:
+        raise ValueError(
+            "sample of {} tokens exceeds pack budget {}".format(
+                int(lengths.max()), budget))
+    if max_per_row < 1:
+        raise ValueError("max_per_row must be >= 1")
+    # Descending lengths, ties by original index: np.lexsort sorts by the
+    # LAST key first, so (index, -length) gives the stable FFD order.
+    order = np.lexsort((np.arange(n), -lengths))
+    free = np.empty(n, dtype=np.int64)      # capacity left per open row
+    counts = np.empty(n, dtype=np.int64)    # samples per open row
+    rows = []                               # [[sample_idx, ...] per row]
+    nrows = 0
+    for idx in order:
+        length = int(lengths[idx])
+        fit = np.flatnonzero((free[:nrows] >= length)
+                             & (counts[:nrows] < max_per_row))
+        if len(fit):
+            r = int(fit[0])
+            rows[r].append(int(idx))
+            free[r] -= length
+            counts[r] += 1
+        else:
+            rows.append([int(idx)])
+            free[nrows] = budget - length
+            counts[nrows] = 1
+            nrows += 1
+    sample_order = np.concatenate(
+        [np.asarray(row, dtype=np.int64) for row in rows])
+    samples_per_row = counts[:nrows].copy()
+    return sample_order, samples_per_row
+
+
+def _column_views(col):
+    """(flat_values, per_row_lens) of a ``list<int32>`` column the sink
+    built (pa.Array via arrowcols.int32_list_array) — zero-copy."""
+    lens = col.value_lengths().to_numpy(zero_copy_only=False).astype(
+        np.int64)
+    values = col.flatten().to_numpy(zero_copy_only=True)
+    return values, lens
+
+
+def pack_columns(columns, n, pack_seq_length, max_per_row, cls_id, sep_id,
+                 masking=False):
+    """Per-sample schema-v2 COLUMNS -> packed-row columns.
+
+    ``columns`` is materialize_columns' output (the token-id columns are
+    required: offline packing is a schema-v2 feature). The emitted
+    ``input_ids`` column stores each row's FULLY INTERLEAVED content —
+    ``[CLS] A [SEP] B [SEP]`` per sample, specials baked in at pack time
+    (that is what lets the loader scatter whole rows instead of
+    re-assembling per sample), and the masking positions are stored
+    ROW-relative for the same reason. Returns
+    ``(packed_columns, n_rows, stats)`` with ``stats`` carrying the
+    placed-token / budget-slot accounting for the
+    ``preprocess_pack_fill_ratio`` gauge."""
+    if "A_ids" not in columns:
+        raise ValueError(
+            "offline packing requires the schema-v2 token-id columns "
+            "(A_ids/B_ids); run with schema_version=2")
+    from .arrowcols import concat_aranges
+    num_tokens = np.asarray(columns["num_tokens"], dtype=np.int64)
+    sample_order, samples_per_row = ffd_pack(num_tokens, pack_seq_length,
+                                             max_per_row)
+    n_rows = len(samples_per_row)
+    row_starts = np.cumsum(samples_per_row) - samples_per_row
+
+    def gathered(col):
+        values, lens = _column_views(col)
+        return gather_list_slices(values, lens, sample_order)
+
+    flat_a, a_sel = gathered(columns["A_ids"])
+    flat_b, b_sel = gathered(columns["B_ids"])
+    tot_sel = a_sel + b_sel + 3
+    assert np.array_equal(tot_sel, num_tokens[sample_order])
+    # Rows tile their samples contiguously, so the concatenated row
+    # contents ARE the samples laid out at their global offsets.
+    global_off = np.cumsum(tot_sel) - tot_sel
+    total = int(tot_sel.sum())
+    content = np.empty(total, dtype=np.int32)
+    content[global_off] = cls_id
+    content[global_off + 1 + a_sel] = sep_id
+    content[global_off + tot_sel - 1] = sep_id
+    content[np.repeat(global_off + 1, a_sel)
+            + concat_aranges(a_sel)] = flat_a
+    content[np.repeat(global_off + 2 + a_sel, b_sel)
+            + concat_aranges(b_sel)] = flat_b
+
+    rn = np.asarray(columns["is_random_next"]).astype(np.int32)
+    row_tokens = (np.add.reduceat(tot_sel, row_starts) if n_rows
+                  else np.zeros(0, dtype=np.int64))
+    assert not n_rows or int(row_tokens.max()) <= pack_seq_length
+    packed = {
+        "input_ids": int32_list_array(content, row_tokens),
+        "pack_a_lens": int32_list_array(a_sel, samples_per_row),
+        "pack_b_lens": int32_list_array(b_sel, samples_per_row),
+        "pack_nsp": int32_list_array(rn[sample_order], samples_per_row),
+        "num_tokens": row_tokens.astype(np.uint16),
+    }
+    if masking:
+        flat_pos, m_sel = gathered(columns["masked_lm_positions_ids"])
+        flat_lab, m_sel2 = gathered(columns["masked_lm_label_ids"])
+        assert np.array_equal(m_sel, m_sel2)
+        # Row-relative positions: the sample's offset inside its row is
+        # its global offset minus the row's global base.
+        row_base = np.cumsum(row_tokens) - row_tokens
+        off_in_row = global_off - np.repeat(row_base, samples_per_row)
+        pos_rowrel = flat_pos + np.repeat(off_in_row, m_sel)
+        # Per-row masked counts via cumsum differences (np.add.reduceat
+        # mishandles empty segments — a row of all-unmasked samples).
+        cum_m = np.zeros(len(m_sel) + 1, dtype=np.int64)
+        np.cumsum(m_sel, out=cum_m[1:])
+        bounds = np.append(row_starts, len(m_sel))
+        row_mask = cum_m[bounds[1:]] - cum_m[bounds[:-1]]
+        packed["masked_lm_positions_ids"] = int32_list_array(pos_rowrel,
+                                                             row_mask)
+        packed["masked_lm_label_ids"] = int32_list_array(flat_lab, row_mask)
+        packed["pack_mask_lens"] = int32_list_array(m_sel, samples_per_row)
+    stats = {
+        "tokens": int(tot_sel.sum()),
+        "slots": int(n_rows) * int(pack_seq_length),
+        "samples": int(n),
+        "rows": int(n_rows),
+    }
+    return packed, n_rows, stats
+
+
+def pack_meta_of(pack_seq_length, max_per_row):
+    """The ``__meta__`` fragment recording the packed row shape — pure
+    function of the shape (manifest content is resume-compared bytes)."""
+    return {"pack_seq_length": int(pack_seq_length),
+            "pack_max_per_row": int(max_per_row)}
+
+
+def pack_shape_of_schema(schema):
+    """(pack_seq_length, pack_max_per_row) off a parquet/arrow schema's
+    metadata, or None for unpacked shards."""
+    md = schema.metadata or {}
+    if PACK_META_SEQ_LENGTH not in md:
+        return None
+    try:
+        return (int(md[PACK_META_SEQ_LENGTH]),
+                int(md.get(PACK_META_MAX_PER_ROW, b"8")))
+    except (TypeError, ValueError):
+        return None
+
+
+def pack_shape_of_parquet(path):
+    """Packed row shape off one shard's footer, or None (unreadable
+    footers are the integrity verifier's problem, not the sniffer's)."""
+    import pyarrow.parquet as pq
+    try:
+        return pack_shape_of_schema(pq.read_schema(path))
+    except (OSError, pa.ArrowInvalid):
+        return None
+
+
+def _record_fill(stats):
+    """Cumulative pack-fill telemetry: the gauge is placed tokens over
+    budget slots across every bucket this process packed so far (the
+    fleet aggregator recomputes the cluster-wide ratio from the two
+    counters, so per-host and fleet numbers agree by construction)."""
+    if not obs.enabled():
+        return
+    obs.inc("preprocess_pack_tokens_total", stats["tokens"])
+    obs.inc("preprocess_pack_slot_tokens_total", stats["slots"])
+    obs.inc("preprocess_pack_rows_total", stats["rows"])
+    reg = obs.registry()
+    slots = reg.counter("preprocess_pack_slot_tokens_total").total()
+    if slots:
+        obs.set_gauge(
+            "preprocess_pack_fill_ratio",
+            reg.counter("preprocess_pack_tokens_total").total() / slots)
+
+
+def write_packed_shard(columns, n, out_dir, part_id, pack_seq_length,
+                       max_per_row, cls_id, sep_id, masking=False,
+                       compression=None):
+    """Pack one bucket's columns and publish ``part.<id>.parquet`` whose
+    rows are budget-sized packed sequences (schema metadata stamps the
+    row shape). Empty buckets produce no file, like the binned sink.
+    Returns {written_path: packed_row_count}."""
+    from . import binning as binning_mod
+    if compression is None:
+        compression = binning_mod.DEFAULT_PARQUET_COMPRESSION
+    if n == 0:
+        return {}
+    packed, n_rows, stats = pack_columns(
+        columns, n, pack_seq_length, max_per_row, cls_id, sep_id,
+        masking=masking)
+    schema = binning_mod.make_packed_schema(
+        masking=masking, pack_seq_length=pack_seq_length,
+        max_per_row=max_per_row)
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "part.{}.parquet".format(part_id))
+    write_table_atomic(
+        pa.table({name: packed[name] for name in schema.names},
+                 schema=schema),
+        path, compression=compression)
+    _record_fill(stats)
+    return {path: n_rows}
+
+
+__all__ = [
+    "PACK_META_MAX_PER_ROW",
+    "PACK_META_SEQ_LENGTH",
+    "ffd_pack",
+    "pack_columns",
+    "pack_meta_of",
+    "pack_shape_of_parquet",
+    "pack_shape_of_schema",
+    "write_packed_shard",
+]

@@ -562,7 +562,8 @@ class BertBucketProcessor:
     engine are rebuilt lazily once per process."""
 
     def __init__(self, tokenizer, config, seed, out_dir, bin_size,
-                 output_format, splitter_params=None):
+                 output_format, splitter_params=None, pack_seq_length=None,
+                 pack_max_per_row=8):
         self.tokenizer = tokenizer
         self.config = config
         self.seed = seed
@@ -570,6 +571,8 @@ class BertBucketProcessor:
         self.bin_size = bin_size
         self.output_format = output_format
         self.splitter_params = splitter_params  # picklable SplitterParams
+        self.pack_seq_length = pack_seq_length  # offline FFD sink budget
+        self.pack_max_per_row = pack_max_per_row
         self._tok_info = None
 
     def __getstate__(self):
@@ -607,11 +610,15 @@ class BertBucketProcessor:
         if cfg.get("schema_version") == 1:
             del cfg["schema_version"]
         cfg = json.dumps(cfg, sort_keys=True, default=str)
-        return processor_fingerprint(type(self).__name__, vocab, cfg,
-                                     self.seed, self.bin_size,
-                                     self.output_format,
-                                     splitter_digest(self.splitter_params),
-                                     "codec=" + binning_mod.DEFAULT_PARQUET_COMPRESSION)
+        fields = [type(self).__name__, vocab, cfg, self.seed, self.bin_size,
+                  self.output_format, splitter_digest(self.splitter_params),
+                  "codec=" + binning_mod.DEFAULT_PARQUET_COMPRESSION]
+        if self.pack_seq_length is not None:
+            # Appended only when packing so every pre-existing (unpacked)
+            # run's digest — and its resumability — is untouched.
+            fields.append("pack={}x{}".format(self.pack_seq_length,
+                                              self.pack_max_per_row))
+        return processor_fingerprint(*fields)
 
     def __call__(self, texts, bucket):
         config, seed = self.config, self.seed
@@ -634,7 +641,12 @@ class BertBucketProcessor:
         return binning_mod.write_shard_columns(
             columns, n, self.out_dir, bucket, masking=config.masking,
             bin_size=self.bin_size,
-            target_seq_length=config.max_seq_length)
+            target_seq_length=config.max_seq_length,
+            pack_seq_length=self.pack_seq_length,
+            pack_max_per_row=self.pack_max_per_row,
+            pack_special_ids=((self.tok_info.cls_id, self.tok_info.sep_id)
+                              if self.pack_seq_length is not None
+                              else None))
 
 
 def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
@@ -1179,6 +1191,8 @@ def run_bert_preprocess(
     holder_id=None,
     scatter_units=None,
     emit_manifest=True,
+    pack_seq_length=None,
+    pack_max_per_row=8,
 ):
     """Run the full BERT preprocessing pipeline (see run_sharded_pipeline
     for the SPMD execution contract). ``num_workers`` > 1 fans the bucket
@@ -1188,12 +1202,39 @@ def run_bert_preprocess(
     (standard multiprocessing semantics). ``resume=True`` continues a
     crashed/failed run from its unit ledger. ``elastic=True`` runs the
     lease-based work-stealing schedule instead of the static one (see
-    run_sharded_pipeline)."""
+    run_sharded_pipeline).
+
+    ``pack_seq_length`` switches the shard sink to OFFLINE sequence
+    packing (preprocess/packing.py): each bucket's instances are
+    first-fit-decreasing-packed into fixed-``pack_seq_length`` rows of at
+    most ``pack_max_per_row`` samples, and the emitted schema-v2 rows are
+    already-packed training rows the loader streams zero-copy. Mutually
+    exclusive with ``bin_size`` (packing subsumes binning); requires
+    ``schema_version=2`` and parquet output, and the budget must hold the
+    longest instance (``pack_seq_length >= config.max_seq_length``)."""
     config = config or BertPretrainConfig()
     if output_format not in ("parquet", "txt"):
         raise ValueError("output_format must be parquet|txt")
     if bin_size is not None:
         binning_mod.num_bins(config.max_seq_length, bin_size)  # validate
+    if pack_seq_length is not None:
+        if bin_size is not None:
+            raise ValueError("pack_seq_length and bin_size are exclusive "
+                             "(packing subsumes binning)")
+        if output_format != "parquet":
+            raise ValueError("offline packing requires parquet output")
+        if config.schema_version != 2:
+            raise ValueError("offline packing requires schema_version=2 "
+                             "(packed rows are id-columnar)")
+        if int(pack_seq_length) < config.max_seq_length:
+            raise ValueError(
+                "pack_seq_length {} cannot hold instances of up to "
+                "max_seq_length {} tokens".format(pack_seq_length,
+                                                  config.max_seq_length))
+        if not (1 <= int(pack_max_per_row)):
+            raise ValueError("pack_max_per_row must be >= 1")
+        if int(pack_seq_length) >= 1 << 16:
+            raise ValueError("pack_seq_length must fit uint16 row totals")
     splitter_params = (train_splitter_params_from_corpus(corpus_paths)
                        if config.splitter == "learned" else None)
 
@@ -1202,7 +1243,9 @@ def run_bert_preprocess(
         out_dir,
         BertBucketProcessor(tokenizer, config, seed, out_dir, bin_size,
                             output_format,
-                            splitter_params=splitter_params),
+                            splitter_params=splitter_params,
+                            pack_seq_length=pack_seq_length,
+                            pack_max_per_row=pack_max_per_row),
         num_blocks=num_blocks,
         sample_ratio=sample_ratio,
         seed=seed,

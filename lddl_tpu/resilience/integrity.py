@@ -114,23 +114,31 @@ def build_manifest(dir_path, comm=None, log=None, extra_meta=None):
         return _build_manifest(dir_path, comm, names, mode, log, extra_meta)
 
 
-def _shard_schema_version(path):
-    """Token-id schema version (1|2) off one shard's parquet footer, or
-    None when the footer is unreadable (the verifier's problem to
-    report, not the meta sniffer's)."""
+def _shard_schema_info(path):
+    """(token-id schema version 1|2, packed row shape or None) off one
+    shard's parquet footer, or (None, None) when the footer is unreadable
+    (the verifier's problem to report, not the meta sniffer's)."""
     import pyarrow as pa
     import pyarrow.parquet as pq
     from ..preprocess.binning import schema_version_of_names
+    from ..preprocess.packing import pack_shape_of_schema
     try:
-        return schema_version_of_names(pq.read_schema(path).names)
+        schema = pq.read_schema(path)
     except (OSError, pa.ArrowInvalid):
-        return None
+        return None, None
+    return schema_version_of_names(schema.names), pack_shape_of_schema(schema)
 
 
 def _build_manifest(dir_path, comm, names, mode, log, extra_meta=None):
     sizes = [0] * len(names)
     crcs = [0] * len(names)
     vflags = [0, 0]  # token-id schema v1 / v2 seen on this rank's stride
+    # Packed-shape homogeneity accumulators, allreduce-sum friendly:
+    # [sum L, sum L^2, sum P, sum P^2, packed shards, unpacked shards].
+    # After the allreduce, the shape is recorded iff every readable shard
+    # is packed AND the (L, P) variance is zero — each index is
+    # contributed by exactly one stride owner, so the sums are exact.
+    pstats = [0, 0, 0, 0, 0, 0]
     for i in range(comm.rank, len(names), comm.world_size):
         path = os.path.join(dir_path, names[i])
         if mode == "size":
@@ -145,12 +153,22 @@ def _build_manifest(dir_path, comm, names, mode, log, extra_meta=None):
             # shard across the whole pod, not per rank). size mode's
             # contract is stat-only / zero extra reads, so it skips the
             # sniff and publishes no __meta__ — like it skips the CRC.
-            v = _shard_schema_version(path)
+            v, pack_shape = _shard_schema_info(path)
             if v is not None:
                 vflags[v - 1] = 1
+                if pack_shape is not None:
+                    L, P = pack_shape
+                    pstats[0] += L
+                    pstats[1] += L * L
+                    pstats[2] += P
+                    pstats[3] += P * P
+                    pstats[4] += 1
+                else:
+                    pstats[5] += 1
     sizes = comm.allreduce_sum(sizes)
     crcs = comm.allreduce_sum(crcs)
     vflags = comm.allreduce_sum(vflags)
+    pstats = [int(x) for x in comm.allreduce_sum(pstats)]
     manifest = {
         n: ({"bytes": int(s), "crc32": int(c)} if mode != "size"
             else {"bytes": int(s)})
@@ -165,6 +183,15 @@ def _build_manifest(dir_path, comm, names, mode, log, extra_meta=None):
         manifest["__meta__"] = {"schema_version": versions[0]}
     elif versions:
         manifest["__meta__"] = {"schema_versions": versions}
+    n_packed = pstats[4]
+    if n_packed and not pstats[5] \
+            and pstats[1] * n_packed == pstats[0] * pstats[0] \
+            and pstats[3] * n_packed == pstats[2] * pstats[2]:
+        # Every readable shard is packed with one (L, P): record the row
+        # shape — the loader's zero-copy packed-path detection gate.
+        from ..preprocess.packing import pack_meta_of
+        manifest.setdefault("__meta__", {})["packed"] = pack_meta_of(
+            pstats[0] // n_packed, pstats[2] // n_packed)
     if extra_meta:
         manifest.setdefault("__meta__", {}).update(extra_meta)
     if comm.rank == 0:

@@ -949,3 +949,49 @@ def test_publish_path_flow_covers_ingest_package(tmp_path):
     }, rules=["publish-path-flow"])
     [f] = flow_findings(report, "publish-path-flow")
     assert f.path == "lddl_tpu/ingest/sink.py"
+
+
+# ----------------------- offline packer module (PR 11)
+
+
+def test_manifest_determinism_covers_pack_meta_builder(tmp_path):
+    """The packer's manifest-meta fragment (pack_meta_of) is
+    resume-compared content: the builder-name gate extends to pack_meta
+    so a clock-shaped packed shape flags like any other manifest
+    nondeterminism."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/preprocess/packing.py": """
+            import time
+
+            def pack_meta_of(budget, per_row):
+                return {"pack_seq_length": budget,
+                        "pack_max_per_row": per_row,
+                        "packed_at": time.time()}
+        """,
+    }, rules=["manifest-determinism"])
+    found = [f for f in report.new if f.rule == "manifest-determinism"]
+    assert len(found) == 1
+    assert found[0].path == "lddl_tpu/preprocess/packing.py"
+
+
+def test_publish_path_flow_covers_packer_module(tmp_path):
+    """The packer module lives in a shard package: a raw parquet write
+    laundered through an outside helper on its call path flags — the
+    packed sink must publish through resilience.io like every other
+    sink."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/utils/rawpq.py": """
+            import pyarrow.parquet as pq
+
+            def dump_table(table, path):
+                pq.write_table(table, path)
+        """,
+        "lddl_tpu/preprocess/packing.py": """
+            from ..utils.rawpq import dump_table
+
+            def write_packed_shard(table, out_dir):
+                dump_table(table, out_dir + "/part.0.parquet")
+        """,
+    }, rules=["publish-path-flow"])
+    [f] = flow_findings(report, "publish-path-flow")
+    assert f.path == "lddl_tpu/preprocess/packing.py"

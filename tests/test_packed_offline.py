@@ -1,0 +1,429 @@
+"""Offline corpus-level sequence packing (preprocess/packing.py + the
+loader's zero-copy prepacked path).
+
+The load-bearing guarantees pinned here:
+
+- FFD-packed shards carry the packed row schema, the footer pack-shape
+  metadata, and the manifest ``__meta__.packed`` entry;
+- packing is deterministic — byte-identical shards under reversed
+  filesystem enumeration — and pure arithmetic (bounds respected);
+- packed shards are SAMPLE-EQUIVALENT to the unpacked schema-v2 shards
+  of the same run: the exploded sample multiset matches exactly,
+  including the static-masking positions/labels bytes (masking happened
+  before packing on the same frozen Philox streams);
+- the loader auto-detects packed directories, streams rows zero-copy
+  through BertPrepackedCollate (no load-time packing), reproduces its
+  epochs deterministically, and reports pad_ratio at or below the greedy
+  load-time packer's on the same corpus;
+- the greedy load-time packer remains the fallback for unpacked dirs;
+- the delta balancer refuses a packed-shape drift;
+- the offline packer emits the pack-fill telemetry.
+"""
+
+import collections
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu import observability as obs  # noqa: E402
+from lddl_tpu.loader import get_bert_pretrain_data_loader  # noqa: E402
+from lddl_tpu.loader.bert import (BertPrepackedCollate,  # noqa: E402
+                                  PackedBertLoader, PackedRow,
+                                  decode_record_batch, packed_shape_of_dir)
+from lddl_tpu.preprocess import packing as packing_mod  # noqa: E402
+from lddl_tpu.resilience.io import read_table  # noqa: E402
+from lddl_tpu.utils.fs import get_all_parquets_under  # noqa: E402
+
+L_PACK = 64
+P_MAX = 8
+
+
+@pytest.fixture(scope="module")
+def pipe(tmp_path_factory):
+    """corpus -> vocab -> preprocess unpacked-v2 AND offline-packed
+    (dynamic + static masking) -> balanced shards."""
+    from lddl_tpu.preprocess import (BertPretrainConfig, get_tokenizer,
+                                     run_bert_preprocess)
+    from lddl_tpu.balance import balance_shards
+    root = tmp_path_factory.mktemp("packed_offline")
+    corpus = gs.build_corpus(str(root / "corpus"))
+    vocab = gs.build_vocab(str(root))
+    tok = get_tokenizer(vocab_file=vocab)
+    out = {"vocab": vocab, "tokenizer": tok, "root": root, "corpus": corpus}
+    for kind, masking in (("dyn", False), ("sta", True)):
+        for mode, pack in (("plain", None), ("packed", L_PACK)):
+            pre = str(root / "pre_{}_{}".format(kind, mode))
+            bal = str(root / "bal_{}_{}".format(kind, mode))
+            run_bert_preprocess(
+                {"wikipedia": corpus}, pre, tok,
+                config=BertPretrainConfig(max_seq_length=32,
+                                          masking=masking,
+                                          duplicate_factor=2),
+                num_blocks=4, sample_ratio=1.0, seed=0,
+                pack_seq_length=pack, pack_max_per_row=P_MAX)
+            balance_shards(pre, bal, 4)
+            out[(kind, mode)] = bal
+            out[(kind, mode, "pre")] = pre
+    return out
+
+
+def _explode_packed(paths):
+    """Packed shards -> per-sample tuples (a, b, rn[, positions, labels])
+    via the loader decode: the stored row content is re-split at the
+    boundary columns, and the row-relative masking positions are rebased
+    back to sample-relative for the comparison."""
+    out = []
+    for p in sorted(paths):
+        for rb in read_table(p).to_batches():
+            for row in decode_record_batch(rb):
+                assert isinstance(row, PackedRow)
+                m_off = 0
+                for k in range(len(row.a_lens)):
+                    al, bl = int(row.a_lens[k]), int(row.b_lens[k])
+                    off = int(row.off[k])
+                    tot = al + bl + 3
+                    a = tuple(int(x) for x in
+                              row.ids[off + 1:off + 1 + al])
+                    b = tuple(int(x) for x in
+                              row.ids[off + 2 + al:off + tot - 1])
+                    if row.mlm_pos is not None:
+                        ml = int(row.mask_lens[k])
+                        pos = tuple(int(x) - off for x in
+                                    row.mlm_pos[m_off:m_off + ml])
+                        lab = tuple(int(x) for x in
+                                    row.mlm_labels[m_off:m_off + ml])
+                        m_off += ml
+                        out.append((a, b, int(row.nsp[k]), pos, lab))
+                    else:
+                        out.append((a, b, int(row.nsp[k])))
+    return out
+
+
+def _explode_plain_v2(paths):
+    out = []
+    for p in sorted(paths):
+        for rb in read_table(p).to_batches():
+            for s in decode_record_batch(rb):
+                a = tuple(int(x) for x in s[0])
+                b = tuple(int(x) for x in s[1])
+                if len(s) == 5:
+                    out.append((a, b, int(s[2]),
+                                tuple(int(x) for x in s[3]),
+                                tuple(int(x) for x in s[4])))
+                else:
+                    out.append((a, b, int(s[2])))
+    return out
+
+
+# ------------------------------------------------------------- pure FFD
+
+
+def test_ffd_pack_bounds_and_determinism():
+    lengths = np.array([30, 10, 50, 64, 5, 5, 33, 31, 2, 64, 17])
+    order, per_row = packing_mod.ffd_pack(lengths, 64, 4)
+    assert sorted(order.tolist()) == list(range(len(lengths)))
+    assert per_row.sum() == len(lengths)
+    # Row bounds: token budget and max-per-row both respected.
+    start = 0
+    for count in per_row:
+        row = order[start:start + count]
+        assert len(row) <= 4
+        assert lengths[row].sum() <= 64
+        start += count
+    # Deterministic: a second call is identical.
+    order2, per_row2 = packing_mod.ffd_pack(lengths, 64, 4)
+    np.testing.assert_array_equal(order, order2)
+    np.testing.assert_array_equal(per_row, per_row2)
+    # First-fit-DECREASING: the first row opens with the longest sample.
+    assert lengths[order[0]] == 64
+
+
+def test_ffd_pack_rejects_oversized_sample():
+    with pytest.raises(ValueError, match="exceeds pack budget"):
+        packing_mod.ffd_pack([10, 70], 64, 8)
+
+
+def test_ffd_fill_at_least_streaming_first_fit(pipe):
+    """FFD over the whole bucket must fill at least as tightly as the
+    loader's streaming first-fit over the same lengths — the premise of
+    moving packing offline."""
+    from lddl_tpu.ops.packing import StreamPacker
+    rng = np.random.default_rng  # noqa: F841 (keyed below, not used raw)
+    lengths = []
+    for p in sorted(get_all_parquets_under(pipe[("dyn", "plain")])):
+        lengths.extend(int(v) for v in
+                       read_table(p).column("num_tokens").to_pylist())
+    lengths = np.asarray(lengths[:2000])
+    order, per_row = packing_mod.ffd_pack(lengths, L_PACK, P_MAX)
+    ffd_rows = len(per_row)
+    packer = StreamPacker(L_PACK, emit_rows=16, max_per_row=P_MAX)
+    stream_rows = 0
+    for length in lengths:
+        if packer.add(int(length)) is None:
+            stream_rows += len(packer.emit_fullest())
+            assert packer.add(int(length)) is not None
+    while packer.open_rows:
+        stream_rows += len(packer.emit_fullest())
+    assert ffd_rows <= stream_rows
+
+
+# ------------------------------------------------- shard format + meta
+
+
+def test_packed_shard_structure_and_meta(pipe):
+    import json
+    import pyarrow.parquet as pq
+    for kind, extra in (("dyn", set()),
+                        ("sta", {"masked_lm_positions_ids",
+                                 "masked_lm_label_ids", "pack_mask_lens"})):
+        paths = get_all_parquets_under(pipe[(kind, "packed")])
+        schema = pq.read_schema(paths[0])
+        names = set(schema.names)
+        assert {"input_ids", "pack_a_lens", "pack_b_lens",
+                "pack_nsp", "num_tokens"} | extra == names
+        assert packing_mod.pack_shape_of_schema(schema) == (L_PACK, P_MAX)
+        with open(os.path.join(pipe[(kind, "packed")],
+                               ".manifest.json")) as f:
+            meta = json.load(f)["__meta__"]
+        assert meta["packed"] == {"pack_seq_length": L_PACK,
+                                  "pack_max_per_row": P_MAX}
+        assert meta["schema_version"] == 2
+        assert packed_shape_of_dir(pipe[(kind, "packed")]) == (L_PACK,
+                                                               P_MAX)
+        # Row invariant: every row's used tokens fit the budget, the
+        # boundary columns are self-consistent, and the stored content
+        # carries the [CLS]/[SEP] structure at the boundary offsets.
+        tok = pipe["tokenizer"]
+        cls_id = tok.convert_tokens_to_ids("[CLS]")
+        sep_id = tok.convert_tokens_to_ids("[SEP]")
+        t = read_table(paths[0])
+        a = t.column("pack_a_lens").to_pylist()
+        b = t.column("pack_b_lens").to_pylist()
+        used = t.column("num_tokens").to_pylist()
+        ids = t.column("input_ids").to_pylist()
+        for al, bl, n, content in zip(a, b, used, ids):
+            assert len(al) == len(bl) <= P_MAX
+            assert sum(al) + sum(bl) + 3 * len(al) == n <= L_PACK
+            assert len(content) == n
+            off = 0
+            for ak, bk in zip(al, bl):
+                assert content[off] == cls_id
+                assert content[off + 1 + ak] == sep_id
+                assert content[off + ak + bk + 2] == sep_id
+                off += ak + bk + 3
+    assert packed_shape_of_dir(pipe[("dyn", "plain")]) is None
+
+
+def test_ffd_determinism_under_reversed_fs(pipe, tmp_path, monkeypatch):
+    """Packed shard bytes are a pure function of the plan: re-running the
+    identical preprocess under REVERSED filesystem enumeration produces
+    byte-identical part files."""
+    import hashlib
+    from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
+
+    def hashes(d):
+        return {os.path.basename(p):
+                hashlib.sha256(open(p, "rb").read()).hexdigest()
+                for p in get_all_parquets_under(d)}
+
+    want = hashes(pipe[("dyn", "packed", "pre")])
+    real_walk, real_listdir = os.walk, os.listdir
+
+    def reversed_walk(top, **kwargs):
+        for dirpath, dirnames, filenames in real_walk(top, **kwargs):
+            rd = list(reversed(sorted(dirnames)))
+            yield dirpath, rd, list(reversed(sorted(filenames)))
+            dirnames[:] = rd
+
+    monkeypatch.setattr(os, "walk", reversed_walk)
+    monkeypatch.setattr(
+        os, "listdir", lambda p=".": list(reversed(sorted(real_listdir(p)))))
+    redo = str(tmp_path / "redo")
+    run_bert_preprocess(
+        {"wikipedia": pipe["corpus"]}, redo, pipe["tokenizer"],
+        config=BertPretrainConfig(max_seq_length=32, masking=False,
+                                  duplicate_factor=2),
+        num_blocks=4, sample_ratio=1.0, seed=0,
+        pack_seq_length=L_PACK, pack_max_per_row=P_MAX)
+    monkeypatch.undo()
+    assert hashes(redo) == want
+
+
+# ------------------------------------------------- sample equivalence
+
+
+@pytest.mark.parametrize("kind", ("dyn", "sta"))
+def test_packed_shards_sample_equivalent_to_unpacked(pipe, kind):
+    """The acceptance pin: the packed corpus holds EXACTLY the load-time
+    packer's input samples — same (a, b, nsp) multiset, and for static
+    masking the same positions/labels bytes (masking ran before packing
+    on the frozen Philox streams)."""
+    packed = _explode_packed(
+        get_all_parquets_under(pipe[(kind, "packed", "pre")]))
+    plain = _explode_plain_v2(
+        get_all_parquets_under(pipe[(kind, "plain", "pre")]))
+    assert collections.Counter(packed) == collections.Counter(plain)
+    assert len(packed) == len(plain) > 0
+
+
+# ------------------------------------------------------------- loading
+
+
+def test_loader_selects_prepacked_path_and_counts(pipe, tmp_path):
+    loader = get_bert_pretrain_data_loader(
+        pipe[("sta", "packed")], vocab_file=pipe["vocab"], batch_size=4,
+        num_workers=2, base_seed=7)
+    assert isinstance(loader._collate_fn, BertPrepackedCollate)
+    assert not obs.enabled()
+    obs.configure(dir=str(tmp_path / "metrics"))
+    try:
+        reg = obs.registry()
+        packed0 = reg.counter("loader_decode_packed_batches_total").total()
+        col0 = reg.counter("loader_decode_columnar_batches_total").total()
+        batches = list(loader)
+        # Deltas, not absolutes: the process-wide registry may carry
+        # counts from earlier tests in the same session.
+        assert reg.counter(
+            "loader_decode_packed_batches_total").total() > packed0
+        assert reg.counter(
+            "loader_decode_columnar_batches_total").total() == col0
+    finally:
+        obs.disable()
+    for batch in batches:
+        n, width = batch["input_ids"].shape
+        assert width == L_PACK
+        assert batch["segments"].shape == (n, width)
+        assert batch["cls_positions"].shape == (n, P_MAX)
+        assert batch["next_sentence_labels"].shape == (n, P_MAX)
+        # Segment ids are block-contiguous and boundary-consistent:
+        # attention_mask marks exactly the used tokens.
+        assert (batch["attention_mask"] == (batch["segments"] > 0)).all()
+
+
+def test_offline_pad_ratio_not_worse_than_loadtime(pipe):
+    loader = get_bert_pretrain_data_loader(
+        pipe[("dyn", "packed")], vocab_file=pipe["vocab"], batch_size=4,
+        num_workers=2, base_seed=7)
+    real = slots = 0
+    for batch in loader:
+        real += int(batch["attention_mask"].sum())
+        slots += int(batch["attention_mask"].size)
+    offline_pad = 1.0 - real / slots
+    lt = get_bert_pretrain_data_loader(
+        pipe[("dyn", "plain")], vocab_file=pipe["vocab"], batch_size=16,
+        num_workers=2, base_seed=7, pack_seq_length=L_PACK, pack_rows=4,
+        pack_max_per_row=P_MAX)
+    assert isinstance(lt, PackedBertLoader)  # greedy fallback survives
+    for _ in lt:
+        pass
+    assert offline_pad <= lt.pad_ratio + 1e-9
+
+
+def test_packed_loader_epochs_are_reproducible(pipe):
+    kw = dict(vocab_file=pipe["vocab"], batch_size=4, num_workers=2,
+              base_seed=11)
+    a = get_bert_pretrain_data_loader(pipe[("sta", "packed")], **kw)
+    b = get_bert_pretrain_data_loader(pipe[("sta", "packed")], **kw)
+    for _ in range(2):
+        batches_a, batches_b = list(a), list(b)
+        assert len(batches_a) == len(batches_b) > 0
+        for x, y in zip(batches_a, batches_b):
+            assert sorted(x) == sorted(y)
+            for key in x:
+                np.testing.assert_array_equal(x[key], y[key], err_msg=key)
+
+
+def test_packed_loader_validations(pipe):
+    with pytest.raises(ValueError, match="packed offline at "
+                                         "pack_seq_length"):
+        get_bert_pretrain_data_loader(
+            pipe[("dyn", "packed")], vocab_file=pipe["vocab"],
+            batch_size=4, pack_seq_length=128, pack_rows=4)
+    with pytest.raises(ValueError, match="return_raw_samples"):
+        get_bert_pretrain_data_loader(
+            pipe[("dyn", "packed")], vocab_file=pipe["vocab"],
+            batch_size=4, return_raw_samples=True)
+    with pytest.raises(ValueError, match="fixed_seq_lengths"):
+        get_bert_pretrain_data_loader(
+            pipe[("dyn", "packed")], vocab_file=pipe["vocab"],
+            batch_size=4, fixed_seq_lengths=[64])
+
+
+def test_prepacked_collate_refuses_plain_samples(pipe):
+    collate = BertPrepackedCollate(pipe["tokenizer"], L_PACK, P_MAX)
+    with pytest.raises(TypeError, match="PackedRow"):
+        collate([("a b", "c d", False)])
+
+
+# ------------------------------------------------------------ telemetry
+
+
+def test_pack_fill_ratio_metrics(pipe, tmp_path):
+    from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
+    assert not obs.enabled()
+    obs.configure(dir=str(tmp_path / "metrics"))
+    try:
+        run_bert_preprocess(
+            {"wikipedia": pipe["corpus"]}, str(tmp_path / "pre"),
+            pipe["tokenizer"],
+            config=BertPretrainConfig(max_seq_length=32, masking=False,
+                                      duplicate_factor=2),
+            num_blocks=4, sample_ratio=1.0, seed=0,
+            pack_seq_length=L_PACK, pack_max_per_row=P_MAX)
+        reg = obs.registry()
+        placed = reg.counter("preprocess_pack_tokens_total").total()
+        slotted = reg.counter("preprocess_pack_slot_tokens_total").total()
+        assert 0 < placed <= slotted
+        gauge = reg.gauge("preprocess_pack_fill_ratio").snapshot()["values"]
+        assert abs(gauge[""] - placed / slotted) < 1e-9
+        assert gauge[""] > 0.5  # FFD on short samples packs tightly
+    finally:
+        obs.disable()
+
+
+# ------------------------------------------------------- delta balance
+
+
+def test_delta_refuses_packed_shape_drift(pipe, tmp_path):
+    from lddl_tpu.balance import delta as delta_mod
+    from lddl_tpu.utils.fs import get_num_samples_of_parquet
+    root = pipe[("dyn", "packed")]
+    prior = {os.path.basename(p): get_num_samples_of_parquet(p)
+             for p in get_all_parquets_under(root)}
+    unpacked_parts = get_all_parquets_under(pipe[("dyn", "plain", "pre")])
+    with pytest.raises(ValueError, match="packed row shape"):
+        delta_mod.stage_delta_balance(
+            root, 1, unpacked_parts, str(tmp_path / "stage"), prior=prior)
+
+
+# ------------------------------------------------------- model contract
+
+
+def test_packed_batch_feeds_packed_model(pipe):
+    """One real offline-packed batch through one jitted packed train
+    step: shapes, segments and per-slot NSP labels all line up with
+    models.BertForPreTrainingPacked."""
+    import jax
+    from lddl_tpu.loader import to_device_batch
+    from lddl_tpu.models import (BertConfig, create_train_state,
+                                 make_sharded_train_step)
+    from lddl_tpu.models.bert import BertForPreTrainingPacked
+    from lddl_tpu.parallel import make_mesh
+    loader = get_bert_pretrain_data_loader(
+        pipe[("sta", "packed")], vocab_file=pipe["vocab"], batch_size=2,
+        num_workers=1, base_seed=3)
+    batch = next(iter(loader))
+    vocab_size = -(-len(pipe["tokenizer"]) // 128) * 128
+    cfg = BertConfig.tiny(vocab_size=vocab_size,
+                          max_position_embeddings=L_PACK)
+    mesh = make_mesh({"dp": 1}, devices=[jax.devices()[0]])
+    model = BertForPreTrainingPacked(cfg)
+    state, _ = create_train_state(cfg, mesh, batch, model=model)
+    step = make_sharded_train_step(mesh, cfg, model=model)
+    state, metrics = step(state, to_device_batch(batch, mesh), seed=0)
+    assert np.isfinite(float(np.asarray(metrics["loss"])))

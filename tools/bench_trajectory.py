@@ -105,6 +105,13 @@ def load_loader_bench(repo_root):
         k: v.get("v2_over_v1") for k, v in speedup.items()
         if isinstance(v, dict)
     }
+    packed = doc.get("packed_offline_speedup") or {}
+    out["packed_offline_over_loadtime"] = {
+        k: {"x": v.get("offline_over_loadtime"),
+            "pad_offline": v.get("offline_pad_ratio"),
+            "pad_loadtime": v.get("loadtime_pad_ratio")}
+        for k, v in packed.items() if isinstance(v, dict)
+    }
     configs = doc.get("configs") or {}
     out["sustained_samples_per_s"] = {
         k: v.get("sustained_samples_per_s") for k, v in sorted(
@@ -169,6 +176,12 @@ def main(argv=None):
         print("loader schema-v2 speedups: " + ", ".join(
             "{}={}x".format(k, v) for k, v in sorted(
                 loader["schema_v2_over_v1"].items())))
+    if loader and loader.get("packed_offline_over_loadtime"):
+        print("offline-packed over load-time packer: " + ", ".join(
+            "{}={}x (pad {} vs {})".format(k, v["x"], v["pad_offline"],
+                                           v["pad_loadtime"])
+            for k, v in sorted(
+                loader["packed_offline_over_loadtime"].items())))
     return 0
 
 

@@ -46,10 +46,11 @@ else
     echo "ci_check: bench_trajectory FAILED (non-gating, ignored)" >&2
 fi
 
-# Non-gating loader health sample: a 1 MB v1-vs-v2 loader_bench smoke that
-# publishes LOADER_BENCH_SMOKE.json as a CI artifact. Opt-in via
-# LDDL_TPU_CI_SMOKE_BENCH=1 (it costs ~a minute of preprocessing, which
-# the static gate itself must not) and NEVER fails the check — the
+# Non-gating loader health sample: a 1 MB loader_bench smoke (the
+# v1-vs-v2 unbinned pair PLUS the offline-packed vs load-time-packed
+# pair) that publishes LOADER_BENCH_SMOKE.json as a CI artifact. Opt-in
+# via LDDL_TPU_CI_SMOKE_BENCH=1 (it costs ~a minute of preprocessing,
+# which the static gate itself must not) and NEVER fails the check — the
 # artifact is for humans watching throughput drift, not a hard gate.
 if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
     if JAX_PLATFORMS=cpu python benchmarks/loader_bench.py --smoke; then

@@ -73,6 +73,10 @@ def format_report(report):
         bits.append("ingest journal at generation {}".format(gen))
     if report.get("pending_work"):
         bits.append("pending work: {}".format(report["pending_work"]))
+    fill = report["totals"]["counters"].get("pack_fill_ratio")
+    if fill is not None:
+        bits.append("offline pack fill {:.4f} (tokens placed / budget "
+                    "slots)".format(fill))
     if bits:
         out.append("; ".join(bits))
     hosts = report["hosts"]
