@@ -101,12 +101,15 @@ def main():
         bench.make_corpus(warm, 1, seed=2)
         run("out_warm", warm)
 
+        from lddl_tpu.preprocess import sink as sink_mod
+        sink_before = sink_mod.stats_snapshot()
         prof = cProfile.Profile()
         t0 = time.perf_counter()
         prof.enable()
         run("out_main", corpus)
         prof.disable()
         elapsed = time.perf_counter() - t0
+        sink_after = sink_mod.stats_snapshot()
 
         buf = io.StringIO()
         st = pstats.Stats(prof, stream=buf)
@@ -163,6 +166,22 @@ def main():
             "note": "cProfile adds interpreter overhead (~10-25%); use "
                     "shares, not absolute seconds, and compare MB/s only "
                     "against other single-worker profiled runs.",
+            # Async-sink attribution note: cProfile instruments only the
+            # producer thread, so with the shard writer on (the default)
+            # sinks_tottime_s IS the producer-side wall — parquet encode/
+            # fsync/publish seconds that moved to the writer thread are
+            # accounted here instead, from preprocess.sink's process-
+            # cumulative stats.
+            "sink_overlap": {
+                "async_depth": sink_mod.sink_depth(),
+                "writer_write_s": round(
+                    sink_after["write_s"] - sink_before["write_s"], 3),
+                "producer_stall_s": round(
+                    sink_after["stall_s"] - sink_before["stall_s"], 3),
+                "deferred_publishes": (sink_after["tasks"]
+                                       - sink_before["tasks"]),
+                "units": sink_after["units"] - sink_before["units"],
+            },
         }
         if previous is not None:
             payload["previous"] = previous

@@ -1,0 +1,107 @@
+"""CI smoke pair for the async durable sink: serial vs async on one small
+corpus — byte identity asserted, throughput pair reported.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_BENCH=1`` (non-
+gating for the timing, but the byte-identity assertion is real: a smoke
+that shipped different bytes would be a correctness alarm, so it exits
+nonzero). Prints one JSON line::
+
+    {"serial_mb_per_s": ..., "async_mb_per_s": ..., "identical": true,
+     "sink": {writer_write_s, producer_stall_s, ...}}
+
+Timing caveat: a 4 MB corpus on a busy CI box is weather, not signal —
+the committed PROFILE_PREPROCESS.json / BENCH_r*.json artifacts are the
+measurements of record; this pair exists so a sink regression (async
+slower than serial by a wide margin, or bytes diverging) is visible per
+commit.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+
+def _tree_digest(out_dir):
+    h = hashlib.sha256()
+    for root, dirs, files in sorted(os.walk(out_dir)):
+        dirs.sort()
+        for name in sorted(files):
+            h.update(name.encode())
+            with open(os.path.join(root, name), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def main():
+    target_mb = float(os.environ.get("LDDL_TPU_SINK_SMOKE_MB", "4"))
+    tmp = tempfile.mkdtemp(prefix="lddl_sink_smoke_")
+    try:
+        from lddl_tpu.preprocess import (
+            BertPretrainConfig, build_wordpiece_vocab, get_tokenizer,
+            run_bert_preprocess)
+        from lddl_tpu.preprocess import sink as sink_mod
+
+        corpus = os.path.join(tmp, "corpus")
+        nbytes, _ = bench.make_corpus(corpus, target_mb, seed=0)
+        sample = []
+        sample_bytes = 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 500_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=8000)
+        tokenizer = get_tokenizer(vocab_file=vocab)
+
+        def run(name, depth):
+            os.environ["LDDL_TPU_SINK_DEPTH"] = str(depth)
+            try:
+                out = os.path.join(tmp, name)
+                t0 = time.perf_counter()
+                run_bert_preprocess(
+                    {"wikipedia": corpus}, out, tokenizer,
+                    config=BertPretrainConfig(max_seq_length=128,
+                                              duplicate_factor=1,
+                                              masking=True),
+                    num_blocks=8, sample_ratio=1.0, seed=12345,
+                    bin_size=32, num_workers=1)
+                elapsed = time.perf_counter() - t0
+            finally:
+                del os.environ["LDDL_TPU_SINK_DEPTH"]
+            return nbytes / 1024 / 1024 / elapsed, _tree_digest(out)
+
+        # Warm once (native build, tokenizer tables) so the pair compares
+        # sink modes, not one-time costs.
+        run("warm", 0)
+        before = sink_mod.stats_snapshot()
+        serial_mb_s, serial_digest = run("serial", 0)
+        async_mb_s, async_digest = run("async", 2)
+        after = sink_mod.stats_snapshot()
+        identical = serial_digest == async_digest
+        print(json.dumps({
+            "smoke": "async-sink serial-vs-async pair",
+            "corpus_mb": round(nbytes / 1024 / 1024, 2),
+            "serial_mb_per_s": round(serial_mb_s, 3),
+            "async_mb_per_s": round(async_mb_s, 3),
+            "identical": identical,
+            "sink": {k: round(after[k] - before[k], 3)
+                     for k in ("write_s", "stall_s", "tasks", "units")},
+        }))
+        return 0 if identical else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

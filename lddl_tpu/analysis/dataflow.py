@@ -82,6 +82,33 @@ PUBLISH_PATH_RULE = "publish-path-flow"
 # with the atomic primitives but never part of the dataset).
 LEASE_MODULE = "lddl_tpu/resilience/leases.py"
 
+# Writer-thread boundary modules (the async shard sink): a callable
+# passed INTO any function of these modules is deferred execution — the
+# sink's writer thread will call it later. Phase A synthesizes a call
+# edge at the enqueue site for every function-valued argument (named
+# function references AND lambda bodies), so the publish-path effect
+# analysis sees "enqueue -> deferred publish" as a call chain and a raw
+# ``pq.write_table``/write-mode ``open`` laundered through
+# ``ShardWriter.submit`` is caught exactly like a direct call
+# (fixture-pinned in tests/test_dataflow.py). Matched by suffix so test
+# fixtures can exercise the boundary with their own sink module copies.
+DEFERRED_CALL_MODULE_SUFFIXES = ("preprocess/sink.py",)
+
+# Method names that enqueue a callable for deferred execution. The async
+# sink's entry point is ``ShardWriter.submit`` — a method on a LOCAL
+# value, which dotted resolution cannot bind to the sink module, so the
+# method NAME is the trigger. concurrent.futures ``pool.submit`` matches
+# too, which is sound for the effect analysis (pool workers really do
+# run the submitted function) and precision-neutral in practice (only
+# function-REFERENCE arguments synthesize edges; call-result arguments
+# are already modeled).
+DEFERRED_METHOD_NAMES = frozenset({"submit"})
+
+
+def _is_deferred_call_module(path):
+    p = (path or "").replace("\\", "/")
+    return any(p.endswith(s) for s in DEFERRED_CALL_MODULE_SUFFIXES)
+
 _WALLCLOCK_SOURCES = frozenset({
     "time.time", "time.time_ns", "time.localtime", "time.gmtime",
     "time.ctime", "time.strftime", "datetime.datetime.now",
@@ -577,6 +604,11 @@ class _Extractor(object):
             if self.facts is not None:
                 self.facts.calls.append({"callee": fi.qualname,
                                          "args": mapped, "lineno": lineno})
+                if _is_deferred_call_module(fi.path):
+                    # Writer-thread boundary: callables handed to the
+                    # async sink run later on its thread — synthesize the
+                    # deferred call edges here (see the module constant).
+                    self._record_deferred_callables(node, env)
             return [["call", fi.qualname, mapped, lineno]]
 
         # Method call on a local/global value or unresolvable receiver.
@@ -584,6 +616,11 @@ class _Extractor(object):
                 and (local_receiver or dotted is None):
             recv = self.eval_expr(node.func.value, env)
             attr = node.func.attr
+            if attr in DEFERRED_METHOD_NAMES and self.facts is not None:
+                # Writer-thread/executor boundary: the enqueued callable
+                # runs later — synthesize its call edge at the enqueue
+                # site so deferred effects stay on the call graph.
+                self._record_deferred_callables(node, env)
             if attr in _DRAW_METHODS:
                 self._sink(["rng"],
                            "drawn from via .{}() — data shaped by an "
@@ -638,6 +675,35 @@ class _Extractor(object):
                 return None  # keyed: determinism auditable at the site
             return "rng"  # module-level global-state draws
         return None
+
+    def _record_deferred_callables(self, node, env):
+        """Synthesize call edges for function-valued arguments at a
+        deferred-execution boundary (the async sink's enqueue): a named
+        project function reference becomes a zero-arg call edge, and a
+        lambda argument's body is walked in place so ITS calls and raw
+        writes attribute to the enclosing (enqueuing) function — either
+        way the publish-path fixpoint sees through the queue."""
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                inner = dict(env)
+                a = arg.args
+                for p in (a.posonlyargs + a.args + a.kwonlyargs):
+                    inner[p.arg] = []
+                self.eval_expr(arg.body, inner)
+                continue
+            if not isinstance(arg, (ast.Name, ast.Attribute)):
+                continue
+            dotted = self.project.resolve_dotted(self.module, arg)
+            if dotted is None:
+                continue
+            fi = self.project.resolve_function(self.module, dotted,
+                                               cls=self.cls)
+            if fi is None:
+                continue
+            self.facts.calls.append({
+                "callee": fi.qualname,
+                "args": [None] * len(fi.params),
+                "lineno": getattr(arg, "lineno", node.lineno)})
 
     def _map_args(self, fi, node, arg_terms, kw_terms):
         """Positional+keyword argument terms mapped onto the callee's

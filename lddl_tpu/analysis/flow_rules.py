@@ -99,7 +99,11 @@ class PublishPathFlowRule(FlowRule):
     doc = ("flow-aware atomic publish: shard-package call paths that "
            "reach a raw write (write-mode open, pq.write_table) in a "
            "helper OUTSIDE the shard packages without passing through "
-           "resilience.io (subsumes atomic-publish across functions)")
+           "resilience.io (subsumes atomic-publish across functions). "
+           "Models the async-sink writer-thread boundary: a callable "
+           "enqueued via preprocess/sink.py is treated as called at the "
+           "enqueue site (dataflow.DEFERRED_CALL_MODULE_SUFFIXES), so "
+           "deferring a raw write cannot launder it past the rule")
     allow = ("lddl_tpu/resilience/io.py",)
 
 

@@ -37,7 +37,8 @@ import os
 import pyarrow as pa
 
 from .. import observability as obs
-from ..preprocess.binning import DEFAULT_PARQUET_COMPRESSION
+from ..preprocess.binning import (DEFAULT_PARQUET_COMPRESSION,
+                                  write_options_for_names)
 
 from ..parallel.distributed import LocalCommunicator
 from ..resilience.integrity import build_manifest
@@ -103,7 +104,8 @@ class _Shard:
         if table is not None:
             assert table.num_rows == num_samples
             write_table_atomic(table, path,
-                               compression=DEFAULT_PARQUET_COMPRESSION)
+                               compression=DEFAULT_PARQUET_COMPRESSION,
+                               **write_options_for_names(table.schema.names))
             _count_bytes_rewritten(path)
 
     def _load(self, num_samples, with_table):
@@ -173,7 +175,8 @@ class _Shard:
             table = pa.concat_tables([read_table(f.path) for f in sources])
             assert table.num_rows == n
             write_table_atomic(table, self.out_path,
-                               compression=DEFAULT_PARQUET_COMPRESSION)
+                               compression=DEFAULT_PARQUET_COMPRESSION,
+                               **write_options_for_names(table.schema.names))
             _count_bytes_rewritten(self.out_path)
             for f in parts:
                 os.remove(f.path)

@@ -43,7 +43,8 @@ import os
 import pyarrow as pa
 
 from .. import observability as obs
-from ..preprocess.binning import DEFAULT_PARQUET_COMPRESSION
+from ..preprocess.binning import (DEFAULT_PARQUET_COMPRESSION,
+                                  write_options_for_names)
 from ..resilience import io as rio
 from ..utils.fs import (
     GENERATION_DIR_RE,
@@ -126,7 +127,8 @@ def _read_concat(paths):
 def _stage_table(table, path):
     os.makedirs(os.path.dirname(path), exist_ok=True)
     rio.write_table_atomic(table, path,
-                           compression=DEFAULT_PARQUET_COMPRESSION)
+                           compression=DEFAULT_PARQUET_COMPRESSION,
+                           **write_options_for_names(table.schema.names))
 
 
 def _bin_inputs(part_paths, carry_in_paths):

@@ -79,6 +79,23 @@ class _InstResult(ctypes.Structure):
     ]
 
 
+class _MaskedInstResult(ctypes.Structure):
+    _fields_ = [
+        ("a_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("seq_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("is_random_next", ctypes.POINTER(ctypes.c_uint8)),
+        ("n_instances", ctypes.c_int64),
+        ("a_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_a_ids", ctypes.c_int64),
+        ("b_ids", ctypes.POINTER(ctypes.c_int32)),
+        ("n_b_ids", ctypes.c_int64),
+        ("mlm_pos", ctypes.POINTER(ctypes.c_int32)),
+        ("mlm_labels", ctypes.POINTER(ctypes.c_int32)),
+        ("mlm_lens", ctypes.POINTER(ctypes.c_int32)),
+        ("n_mlm", ctypes.c_int64),
+    ]
+
+
 def _load():
     global _lib, _lib_tried
     with _lock:
@@ -98,7 +115,7 @@ def _load():
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.
         try:
-            if lib.lddl_native_abi_version() != 6:
+            if lib.lddl_native_abi_version() != 7:
                 return None
         except AttributeError:
             return None
@@ -163,6 +180,17 @@ def _load():
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
             ctypes.c_char_p, ctypes.c_int64]
+        lib.lddl_bert_instances_masked.restype = \
+            ctypes.POINTER(_MaskedInstResult)
+        lib.lddl_bert_instances_masked.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_double, ctypes.c_int32, ctypes.c_int32]
+        lib.lddl_masked_inst_free.argtypes = [
+            ctypes.POINTER(_MaskedInstResult)]
         _lib = lib
         return _lib
 
@@ -179,6 +207,20 @@ def fused_enabled():
     spawned pool workers, which inherit the environment) can flip it."""
     return (_load() is not None
             and os.environ.get("LDDL_TPU_NATIVE_FUSED", "1") != "0")
+
+
+def fused_mask_enabled():
+    """True when the fused-masked instances kernel may run (the top rung
+    of the masking ladder: split + WordPiece + NSP + shuffle + Philox
+    masking replay in one call, no separate lddl_mask_batch pass).
+    ``LDDL_TPU_NATIVE_FUSED_MASK=0`` forces the staged rungs so tests —
+    and production triage — can pin byte identity across the ladder.
+    ``LDDL_TPU_NATIVE_MASK=0`` (the "no C++ masking anywhere" triage
+    knob) also drops this rung: an operator forcing numpy masking must
+    actually get numpy masking, not the fused replay of it."""
+    return (fused_enabled()
+            and os.environ.get("LDDL_TPU_NATIVE_FUSED_MASK", "1") != "0"
+            and os.environ.get("LDDL_TPU_NATIVE_MASK") != "0")
 
 
 def _owned_array(lib, ptr, n, ctype, dtype):
@@ -389,6 +431,71 @@ class NativeTokenizer:
         finally:
             lib.lddl_inst_free(res)  # see tokenize_docs: leak-free
         return seq_ids, seq_lens, a_lens, rn, a_ids, b_ids
+
+    def bert_instances_masked(self, docs, max_seq_length, short_seq_prob,
+                              duplicate_factor, seed, bucket, cls_id,
+                              sep_id, key_bytes, mask_id, vocab_size,
+                              masked_lm_ratio, max_predictions, width_min):
+        """FUSED-MASKED hot path: documents -> MASKED instance arrays in
+        one native pass — everything bert_instances does PLUS the
+        bit-exact numpy-Philox masking replay over the (virtual) padded
+        matrix the staged path would build (key = ``key_bytes`` from
+        utils.rng.sample_key_bytes; same draw-order contract as
+        mask_batch). Returns (a_lens, seq_lens, is_random_next, flat_a,
+        flat_b, sel_positions, sel_lens, label_ids) — masked A/B id
+        segments plus the row-relative mask selection — or None when the
+        parameters fall outside the frozen replay contract (vocab size
+        must be in [2, 2^32))."""
+        vocab_size = int(vocab_size)
+        if not (2 <= vocab_size < 0xFFFFFFFF):
+            return None
+        lib = self._lib
+        z = np.zeros(0, dtype=np.int32)
+        if not len(docs):
+            return (z, z.copy(), np.zeros(0, dtype=bool), z.copy(),
+                    z.copy(), z.copy(), z.copy(), z.copy())
+        buf, starts, ends, n, _keep = _doc_ranges(docs)
+        k0, k1 = np.frombuffer(key_bytes, dtype="<u8")
+        p_i64 = ctypes.POINTER(ctypes.c_int64)
+        res = lib.lddl_bert_instances_masked(
+            self._handle, buf,
+            starts.ctypes.data_as(p_i64), ends.ctypes.data_as(p_i64),
+            n, int(max_seq_length), float(short_seq_prob),
+            int(duplicate_factor), int(seed) & (2**64 - 1),
+            int(bucket) & (2**64 - 1), int(cls_id), int(sep_id),
+            int(k0), int(k1), int(mask_id), vocab_size,
+            float(masked_lm_ratio), int(max_predictions), int(width_min))
+        try:
+            r = res.contents
+            n_inst = r.n_instances
+            a_lens = _owned_array(lib, r.a_lens, n_inst,
+                                  ctypes.c_int32, np.int32)
+            r.a_lens = None
+            seq_lens = _owned_array(lib, r.seq_lens, n_inst,
+                                    ctypes.c_int32, np.int32)
+            r.seq_lens = None
+            rn = _owned_array(lib, r.is_random_next, n_inst,
+                              ctypes.c_uint8, np.uint8).view(np.bool_)
+            r.is_random_next = None
+            flat_a = _owned_array(lib, r.a_ids, r.n_a_ids,
+                                  ctypes.c_int32, np.int32)
+            r.a_ids = None
+            flat_b = _owned_array(lib, r.b_ids, r.n_b_ids,
+                                  ctypes.c_int32, np.int32)
+            r.b_ids = None
+            sel_pos = _owned_array(lib, r.mlm_pos, r.n_mlm,
+                                   ctypes.c_int32, np.int32)
+            r.mlm_pos = None
+            label_ids = _owned_array(lib, r.mlm_labels, r.n_mlm,
+                                     ctypes.c_int32, np.int32)
+            r.mlm_labels = None
+            sel_lens = _owned_array(lib, r.mlm_lens, n_inst,
+                                    ctypes.c_int32, np.int32)
+            r.mlm_lens = None
+        finally:
+            lib.lddl_masked_inst_free(res)  # see tokenize_docs: leak-free
+        return (a_lens, seq_lens, rn, flat_a, flat_b, sel_pos, sel_lens,
+                label_ids)
 
 
 def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,

@@ -21,7 +21,8 @@ import pyarrow as pa
 
 from ..resilience.io import atomic_write, write_table_atomic
 from ..utils import rng as lrng
-from .binning import DEFAULT_PARQUET_COMPRESSION
+from .binning import (DEFAULT_PARQUET_COMPRESSION, SINK_PROFILE_V2,
+                      write_options_for_names)
 from .sentences import split_sentences, split_sentences_learned
 from .runner import run_sharded_pipeline
 
@@ -104,6 +105,11 @@ class BartBucketProcessor:
         if self.tokenizer is not None:
             parts.append("schema=" + self._schema_tag())
         parts.append("codec=" + DEFAULT_PARQUET_COMPRESSION)
+        if self.tokenizer is not None and self.output_format == "parquet":
+            # v2 BART shards use the tuned parquet layout (see
+            # BertBucketProcessor.fingerprint): deliberate one-time
+            # fingerprint bump; tokenizer-less (v1) runs untouched.
+            parts.append("v2sink=" + SINK_PROFILE_V2)
         return processor_fingerprint(*parts)
 
     def _schema_tag(self):
@@ -152,7 +158,11 @@ class BartBucketProcessor:
                 if self.splitter_params is not None else None)
         return native.split_docs(texts, splitter_blob=blob)
 
-    def __call__(self, texts, bucket):
+    def prepare(self, texts, bucket):
+        """Compute phase of the two-phase sink protocol (see
+        runner.BertBucketProcessor.prepare): chunking and tokenization run
+        producer-side; the returned closure performs only the durable
+        write, deferred onto the shard-writer thread."""
         g = lrng.sample_rng(self.seed, 0xBA27, bucket)
         lrng.shuffle(g, texts)
         rows = []
@@ -170,12 +180,17 @@ class BartBucketProcessor:
                 rows.extend(chunks_from_text(
                     text, self.config, g,
                     splitter_params=self.splitter_params))
-        os.makedirs(self.out_dir, exist_ok=True)
+        out_dir = self.out_dir
         if self.output_format == "txt":
-            path = os.path.join(self.out_dir, "{}.txt".format(bucket))
-            atomic_write(path, "".join(r + "\n" for r in rows))
-            return {path: len(rows)}
-        path = os.path.join(self.out_dir, "part.{}.parquet".format(bucket))
+            path = os.path.join(out_dir, "{}.txt".format(bucket))
+
+            def publish_txt():
+                os.makedirs(out_dir, exist_ok=True)
+                atomic_write(path, "".join(r + "\n" for r in rows))
+                return {path: len(rows)}
+
+            return publish_txt
+        path = os.path.join(out_dir, "part.{}.parquet".format(bucket))
         fields = [("sentences", pa.string())]
         columns = {"sentences": rows}
         if self.tokenizer is not None:
@@ -184,10 +199,20 @@ class BartBucketProcessor:
             columns["sentence_lens"] = lens
             fields += [("sentence_ids", pa.list_(pa.int32())),
                        ("sentence_lens", pa.list_(pa.int32()))]
+        write_options = write_options_for_names(columns)
         table = pa.table(columns, schema=pa.schema(fields))
-        write_table_atomic(table, path,
-                           compression=DEFAULT_PARQUET_COMPRESSION)
-        return {path: len(rows)}
+
+        def publish():
+            os.makedirs(out_dir, exist_ok=True)
+            write_table_atomic(table, path,
+                               compression=DEFAULT_PARQUET_COMPRESSION,
+                               **write_options)
+            return {path: len(rows)}
+
+        return publish
+
+    def __call__(self, texts, bucket):
+        return self.prepare(texts, bucket)()
 
 
 def run_bart_preprocess(

@@ -520,6 +520,70 @@ def pairs_from_documents(documents, config, seed, bucket):
 
 
 @dataclasses.dataclass
+class MaskedInstanceBatch:
+    """One bucket's instances with static masking ALREADY applied — the
+    fused-masked kernel's output format (lddl_bert_instances_masked):
+    flat masked A/B id segments plus the row-relative mask selection
+    (positions into [CLS] A [SEP] B [SEP], original label ids, per-row
+    counts). Everything materialize_columns' masking branch derives from
+    the padded matrix arrives precomputed, so no [n, width] array ever
+    exists in Python. Bit-exact to apply_static_masking on the same
+    Philox stream (pinned by tests/test_fused.py)."""
+
+    a_lens: np.ndarray          # int32 [n]
+    seq_lens: np.ndarray        # int32 [n]
+    is_random_next: np.ndarray  # bool [n]
+    flat_a: np.ndarray          # int32, masked A segments row-major
+    flat_b: np.ndarray          # int32, masked B segments row-major
+    sel_positions: np.ndarray   # int32, row-relative selected positions
+    sel_lens: np.ndarray        # int32 [n] selected count per row
+    label_ids: np.ndarray       # int32, original ids at selected positions
+
+    def __len__(self):
+        return len(self.seq_lens)
+
+
+def masked_instances_from_texts(texts, tok_info, config, seed, bucket,
+                                mask_scope, splitter_params=None):
+    """FUSED-MASKED rung: raw document bytes -> masked instance arrays in
+    ONE native call (split + WordPiece + NSP + shuffle + the numpy-Philox
+    masking replay keyed by ``sample_key_bytes(seed, *mask_scope)``).
+
+    Returns a MaskedInstanceBatch, or None when outside the frozen replay
+    contract — numpy engine only, no whole-word masking, vocab size in
+    [2, 2^32), native fused kernel available and semantics-matched, and
+    not force-disabled (``LDDL_TPU_NATIVE_FUSED_MASK=0`` drops to the
+    staged ladder: fused-unmasked + separate mask_batch). The caller MUST
+    fall back on None — refusing into the numpy path is the contract,
+    never a silent engine fork."""
+    if not config.masking or config.whole_word_masking:
+        return None
+    if config.engine != "numpy":
+        return None
+    if not (2 <= tok_info.vocab_size < 0xFFFFFFFF):
+        return None
+    if config.tokenizer_engine not in ("auto", "native"):
+        return None
+    from .. import native
+    if not native.fused_enabled() or not native.fused_mask_enabled():
+        return None
+    nat = tok_info.native_tokenizer()
+    if nat is None:
+        return None
+    _apply_splitter_params(nat, splitter_params)
+    res = nat.bert_instances_masked(
+        texts, config.max_seq_length, config.short_seq_prob,
+        config.duplicate_factor, seed, bucket, tok_info.cls_id,
+        tok_info.sep_id, lrng.sample_key_bytes(seed, *mask_scope),
+        tok_info.mask_id, tok_info.vocab_size, config.masked_lm_ratio,
+        config.max_predictions_per_seq,
+        min(128, config.max_seq_length))
+    if res is None:
+        return None
+    return MaskedInstanceBatch(*res)
+
+
+@dataclasses.dataclass
 class InstanceBatch:
     """One bucket's pretraining instances in flat array form — the native
     engine's output format; the Python engine converts into it. Row i is
@@ -714,6 +778,33 @@ def materialize_columns(batch, config, tok_info, seed, scope):
     n = len(batch)
     if n == 0:
         return {}, 0
+    if isinstance(batch, MaskedInstanceBatch):
+        # Fused-masked fast path: the kernel already applied the Philox
+        # masking replay and emitted exactly the flat arrays the column
+        # builders consume — same values the padded-matrix branch below
+        # would gather, so shard bytes are identical by construction.
+        tok_table = tok_info.token_byte_table()
+        a_lens = np.asarray(batch.a_lens, dtype=np.int64)
+        b_lens = np.asarray(batch.seq_lens, dtype=np.int64) - a_lens - 3
+        sel_lens = np.asarray(batch.sel_lens, dtype=np.int64)
+        columns = {
+            "A": joined_token_strings(batch.flat_a, a_lens, tok_table),
+            "B": joined_token_strings(batch.flat_b, b_lens, tok_table),
+            "is_random_next": np.asarray(batch.is_random_next, dtype=bool),
+            "num_tokens": np.asarray(batch.seq_lens).astype(np.uint16),
+            "masked_lm_positions": serialized_u16_binary(
+                batch.sel_positions, sel_lens),
+            "masked_lm_labels": joined_token_strings(
+                batch.label_ids, sel_lens, tok_table),
+        }
+        if config.schema_version >= 2:
+            columns["A_ids"] = int32_list_array(batch.flat_a, a_lens)
+            columns["B_ids"] = int32_list_array(batch.flat_b, b_lens)
+            columns["masked_lm_positions_ids"] = int32_list_array(
+                batch.sel_positions, sel_lens)
+            columns["masked_lm_label_ids"] = int32_list_array(
+                batch.label_ids, sel_lens)
+        return columns, n
     tok_table = tok_info.token_byte_table()
     a_lens = np.asarray(batch.a_lens, dtype=np.int64)
     seq_lens = np.asarray(batch.seq_lens, dtype=np.int64)

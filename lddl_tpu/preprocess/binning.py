@@ -80,6 +80,15 @@ def schema_version_of_names(names):
     return 2 if any(m in names for m in SCHEMA_V2_MARKERS) else 1
 
 
+def write_options_for_names(names):
+    """The pq.write_table layout options for a shard with these column
+    names: the tuned v2 layout for id-columnar schemas, the legacy
+    (byte-pinned) layout for v1. One rule so the sink and the balancer's
+    row-wise rewrites cannot drift."""
+    return (dict(V2_PARQUET_WRITE_OPTIONS)
+            if schema_version_of_names(names) == 2 else {})
+
+
 def num_bins(target_seq_length, bin_size):
     if bin_size is None:
         return 1
@@ -132,6 +141,25 @@ def make_schema(masking=False, binned=False, token_ids=False):
 # read -66% vs snappy at +8% size — see the README attribution note.
 DEFAULT_PARQUET_COMPRESSION = "lz4"
 
+# Tuned page layout for the id-columnar shard schemas (v2 and packed).
+# Measured on the bench corpus (see the README sink-architecture note):
+# dictionary encoding buys little on mostly-unique joined-token strings
+# and Zipf id lists but costs a dict-build pass per column chunk, page
+# statistics are never consulted (shards are read whole), and the v2
+# data-page header halves the per-page framing — together ~15% off the
+# parquet encode step at a modest size cost. Applied ONLY when the
+# schema is v2/packed: v1 shard bytes are pinned by the golden-spool
+# tests and stay on the legacy layout, so v1 resume fingerprints (and
+# pre-upgrade crashed v1 runs) are untouched. SINK_PROFILE_V2 feeds the
+# v2 resume fingerprints — changing the layout is a deliberate one-time
+# fingerprint bump.
+V2_PARQUET_WRITE_OPTIONS = {
+    "use_dictionary": False,
+    "write_statistics": False,
+    "data_page_version": "2.0",
+}
+SINK_PROFILE_V2 = "lz4.dpv2.nodict.nostats"
+
 
 def write_shard_columns(columns, n, out_dir, part_id, masking=False,
                         bin_size=None, target_seq_length=128,
@@ -164,6 +192,7 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
     os.makedirs(out_dir, exist_ok=True)
     written = {}
     token_ids = "A_ids" in columns  # schema v2 sniffed off the columns
+    write_options = write_options_for_names(columns)
     if bin_size is None:
         schema = make_schema(masking=masking, binned=False,
                              token_ids=token_ids)
@@ -171,7 +200,7 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
         write_table_atomic(
             pa.table({name: columns.get(name, []) for name in schema.names},
                      schema=schema),
-            path, compression=compression)
+            path, compression=compression, **write_options)
         written[path] = n
         return written
 
@@ -220,7 +249,7 @@ def write_shard_columns(columns, n, out_dir, part_id, masking=False,
         # never leave a torn shard under its final name for the resume's
         # exact-prefix cleanup to miss.
         write_table_atomic(pa.table(sub, schema=schema), path,
-                           compression=compression)
+                           compression=compression, **write_options)
         written[path] = hi - lo
     return written
 

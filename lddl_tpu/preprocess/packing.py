@@ -276,7 +276,8 @@ def write_packed_shard(columns, n, out_dir, part_id, pack_seq_length,
     write_table_atomic(
         pa.table({name: packed[name] for name in schema.names},
                  schema=schema),
-        path, compression=compression)
+        path, compression=compression,
+        **binning_mod.write_options_for_names(schema.names))
     _record_fill(stats)
     return {path: n_rows}
 

@@ -52,11 +52,13 @@ from .bert import (
     BertPretrainConfig,
     TokenizerInfo,
     instances_from_texts,
+    masked_instances_from_texts,
     materialize_columns,
     materialize_rows,
 )
 from .readers import discover_source_files, plan_blocks, read_documents
 from . import binning as binning_mod
+from . import sink as sink_mod
 
 _SPOOL_DIR = "_shuffle"
 _LEDGER_DIR = "_done"
@@ -100,7 +102,8 @@ class _Progress:
 
 
 def _run_units(fn, units, pool_factory, log, phase, retry_deaths=True,
-               max_rounds=3, progress_interval=5.0, on_result=None):
+               max_rounds=3, progress_interval=5.0, on_result=None,
+               writer=None):
     """Run ``fn(unit) -> result`` over all units, serially or on a process
     pool, with per-unit fault isolation: a unit whose task raises is
     recorded as failed (others continue). A worker process dying (OOM
@@ -111,6 +114,15 @@ def _run_units(fn, units, pool_factory, log, phase, retry_deaths=True,
     single-worker pools (exact attribution: a unit that breaks its solo
     pool is the culprit and fails; innocents complete). ``on_result`` is
     called as each unit finishes (journal hook — survives a later crash).
+
+    ``writer`` (serial path only): a :class:`sink.ShardWriter` the unit
+    functions defer their durable writes to. A unit returning
+    ``sink.DeferredUnit`` completes asynchronously — its result (or
+    failure) is collected from the writer at the next unit boundary and
+    at the final drain, and ``on_result`` (the ledger journal) fires only
+    then, i.e. only after that unit's writes actually hit stable storage.
+    This is the cross-unit double buffer: unit N's parquet encode + fsync
+    + publish overlap unit N+1's read/tokenize/mask.
     Returns ({unit: result}, {unit: error_string})."""
     import concurrent.futures as cf
     from concurrent.futures.process import BrokenProcessPool
@@ -129,11 +141,32 @@ def _run_units(fn, units, pool_factory, log, phase, retry_deaths=True,
         progress.tick()
 
     if pool_factory is None:
-        for u in units:
+        def safe_record(u, res):
+            # Per-unit isolation extends to the journal hook itself: an
+            # on_result failure (e.g. persistent EIO on the ledger dir)
+            # fails THAT unit, never the whole phase.
             try:
-                record(u, fn(u))
+                record(u, res)
             except Exception as e:  # noqa: BLE001 - isolate per unit
                 record_failure(u, "{}: {}".format(type(e).__name__, e))
+
+        for u in units:
+            if writer is not None:
+                # Collect (and journal) units whose deferred writes have
+                # finished while this thread was computing later units.
+                sink_mod.collect_into(writer.completed(), safe_record,
+                                      record_failure)
+            try:
+                res = fn(u)
+                if writer is not None \
+                        and isinstance(res, sink_mod.DeferredUnit):
+                    continue  # completes at a later collect / final drain
+                record(u, res)
+            except Exception as e:  # noqa: BLE001 - isolate per unit
+                record_failure(u, "{}: {}".format(type(e).__name__, e))
+        if writer is not None:
+            sink_mod.collect_into(writer.drain(), safe_record,
+                                  record_failure)
         return results, failures
 
     pending = list(units)
@@ -367,8 +400,12 @@ def _scan_block_documents(block, sample_ratio, base_seed):
     arr = np.frombuffer(data, dtype=np.uint8)
     n = len(arr)
     is_ws = _WS_TABLE[arr]
+    # ONE nonzero pass: newlines are whitespace (0x0A is in _WS_TABLE),
+    # so the line scan is a cheap sub-select of the word scan instead of
+    # a second full-buffer np.nonzero (this pair was a profile-top-5
+    # hotspot: two O(n) scans per block where one suffices).
     ws_pos = np.flatnonzero(is_ws)  # ~one per word; cheap to search
-    nl = np.flatnonzero(arr == 0x0A)
+    nl = ws_pos[arr[ws_pos] == 0x0A]
     nlines = len(nl) + (0 if (len(nl) and nl[-1] == n - 1) else 1)
     line_starts = np.zeros(nlines, dtype=np.int64)
     line_starts[1:] = nl[:nlines - 1] + 1
@@ -613,6 +650,15 @@ class BertBucketProcessor:
         fields = [type(self).__name__, vocab, cfg, self.seed, self.bin_size,
                   self.output_format, splitter_digest(self.splitter_params),
                   "codec=" + binning_mod.DEFAULT_PARQUET_COMPRESSION]
+        if (self.config.schema_version == 2
+                and self.output_format == "parquet"):
+            # The id-columnar (v2/packed) shards use the tuned parquet
+            # layout (binning.SINK_PROFILE_V2): different bytes, so a v2
+            # resume across the layout change must refuse — a deliberate
+            # one-time fingerprint bump. v1 shards keep the legacy layout
+            # byte-for-byte (golden-spool pins), so v1 digests — and
+            # pre-upgrade crashed v1 runs — are untouched.
+            fields.append("v2sink=" + binning_mod.SINK_PROFILE_V2)
         if self.pack_seq_length is not None:
             # Appended only when packing so every pre-existing (unpacked)
             # run's digest — and its resumability — is untouched.
@@ -620,33 +666,62 @@ class BertBucketProcessor:
                                               self.pack_max_per_row))
         return processor_fingerprint(*fields)
 
-    def __call__(self, texts, bucket):
+    def prepare(self, texts, bucket):
+        """Compute phase of the two-phase sink protocol: shuffle ->
+        instances -> masking -> columns, all producer-side; returns a
+        zero-argument *deferred publish closure* that performs only the
+        durable write (sink.ShardWriter executes it on the writer
+        thread, pipelined against the next bucket's compute).
+        ``prepare(texts, b)()`` is exactly the old inline behavior."""
         config, seed = self.config, self.seed
         g = lrng.sample_rng(seed, 0x9A1A, bucket)
         lrng.shuffle(g, texts)
-        batch = instances_from_texts(texts, self.tok_info, config, seed,
-                                     bucket,
-                                     splitter_params=self.splitter_params)
         if self.output_format == "txt":
+            batch = instances_from_texts(
+                texts, self.tok_info, config, seed, bucket,
+                splitter_params=self.splitter_params)
             rows = materialize_rows(batch, config, self.tok_info, seed,
                                     (0x3A5C, bucket))
-            return _write_txt_shard(rows, self.out_dir, bucket,
-                                    config.masking, self.bin_size,
-                                    config.max_seq_length)
+            return lambda: _write_txt_shard(rows, self.out_dir, bucket,
+                                            config.masking, self.bin_size,
+                                            config.max_seq_length)
+        batch = None
+        if config.masking:
+            # Fused-masked rung: split + WordPiece + NSP + shuffle + the
+            # Philox masking replay in ONE native call (no padded matrix
+            # ever exists in Python). None = out of the replay contract;
+            # fall through to the staged ladder.
+            batch = masked_instances_from_texts(
+                texts, self.tok_info, config, seed, bucket, (0x3A5C, bucket),
+                splitter_params=self.splitter_params)
+        if batch is None:
+            batch = instances_from_texts(
+                texts, self.tok_info, config, seed, bucket,
+                splitter_params=self.splitter_params)
         columns, n = materialize_columns(batch, config, self.tok_info, seed,
                                          (0x3A5C, bucket))
         if obs.enabled() and "num_tokens" in columns:
             obs.inc("preprocess_tokens_total",
                     int(sum(int(t) for t in columns["num_tokens"])))
-        return binning_mod.write_shard_columns(
-            columns, n, self.out_dir, bucket, masking=config.masking,
-            bin_size=self.bin_size,
-            target_seq_length=config.max_seq_length,
-            pack_seq_length=self.pack_seq_length,
-            pack_max_per_row=self.pack_max_per_row,
-            pack_special_ids=((self.tok_info.cls_id, self.tok_info.sep_id)
-                              if self.pack_seq_length is not None
-                              else None))
+        out_dir, bin_size = self.out_dir, self.bin_size
+        pack_seq_length = self.pack_seq_length
+        pack_max_per_row = self.pack_max_per_row
+        pack_special_ids = ((self.tok_info.cls_id, self.tok_info.sep_id)
+                            if pack_seq_length is not None else None)
+
+        def publish():
+            return binning_mod.write_shard_columns(
+                columns, n, out_dir, bucket, masking=config.masking,
+                bin_size=bin_size,
+                target_seq_length=config.max_seq_length,
+                pack_seq_length=pack_seq_length,
+                pack_max_per_row=pack_max_per_row,
+                pack_special_ids=pack_special_ids)
+
+        return publish
+
+    def __call__(self, texts, bucket):
+        return self.prepare(texts, bucket)()
 
 
 def _write_txt_shard(rows, out_dir, part_id, masking, bin_size,
@@ -711,12 +786,14 @@ def _record_bucket_written(written, bucket):
     obs.observe("preprocess_bucket_samples", total)
 
 
-def _run_block_bucket(spec, process_bucket, bucket, fence=None):
+def _run_block_bucket(spec, process_bucket, bucket, fence=None, writer=None):
     """No-global-shuffle unit: bucket == block; re-read the block directly
     (texts never cross the process boundary). ``fence`` (elastic mode):
     checked after reading and before writing — a holder whose lease was
     stolen self-terminates instead of publishing from possibly-stale
-    state."""
+    state. ``writer`` (static serial path): the unit's durable write is
+    deferred onto the shard-writer thread and the unit completes (and
+    journals) when it drains — see sink.ShardWriter."""
     input_files = discover_source_files(spec["corpus_paths"])
     blocks = plan_blocks(input_files, spec["num_blocks"])
     texts = [text for _, text in read_documents(
@@ -725,6 +802,13 @@ def _run_block_bucket(spec, process_bucket, bucket, fence=None):
     if spec.get("clean_first"):
         _clean_bucket_outputs(spec["out_dir"], bucket)
     _check_fence(fence, bucket)
+    prepare = getattr(process_bucket, "prepare", None)
+    if writer is not None and prepare is not None:
+        with obs.span("preprocess.process_block", bucket=bucket):
+            publish = prepare(texts, bucket)
+        writer.submit(bucket, _publish_task(publish, bucket), fence=fence)
+        writer.end_unit(bucket)
+        return sink_mod.DeferredUnit(bucket)
     with obs.span("preprocess.process_block", bucket=bucket):
         written = process_bucket(texts, bucket)
     _record_bucket_written(written, bucket)
@@ -757,22 +841,67 @@ def _clean_bucket_outputs(out_dir, bucket):
             os.remove(path)
 
 
-def _run_group(spec, process_bucket, group, fence=None):
+def _publish_task(publish, bucket):
+    """Wrap a processor's deferred publish closure with the per-bucket
+    sample accounting (runs on the writer thread; obs is thread-safe)."""
+    def task():
+        written = publish()
+        _record_bucket_written(written, bucket)
+        return written
+    return task
+
+
+def _run_group(spec, process_bucket, group, fence=None, writer=None):
     """Gather unit: read one coarse spool group, process each fine bucket.
     ``fence`` (elastic mode) is checked after the spool read and before
-    every bucket's writes — see `_check_fence`."""
+    every bucket's compute — and re-checked by the shard writer
+    immediately before every deferred publish (see `_check_fence` and
+    sink.ShardWriter).
+
+    The durable sink runs asynchronously whenever the processor exposes
+    the two-phase ``prepare`` protocol: with a ``writer`` passed in (the
+    static serial path) writes are deferred ACROSS units and the call
+    returns ``sink.DeferredUnit``; otherwise (pool workers, the elastic
+    claim loop) an own writer pipelines the buckets WITHIN the unit and
+    drains before returning, so the unit's result — and any journal
+    record derived from it — still strictly follows its bytes."""
     with obs.span("preprocess.gather_group", group=group):
         texts_by_bucket = _read_group_texts(spec["out_dir"], group,
                                             spec["nbuckets"], spec["ngroups"],
                                             accept=spec.get("spool_accept"))
-        written = {}
-        for bucket in sorted(texts_by_bucket):
-            if spec.get("clean_first"):
-                _clean_bucket_outputs(spec["out_dir"], bucket)
-            _check_fence(fence, group)
-            bucket_written = process_bucket(texts_by_bucket[bucket], bucket)
-            _record_bucket_written(bucket_written, bucket)
-            written.update(bucket_written)
+        prepare = getattr(process_bucket, "prepare", None)
+        if prepare is None:
+            # Processors without the two-phase protocol (custom test
+            # callables): the historical inline path, unchanged.
+            written = {}
+            for bucket in sorted(texts_by_bucket):
+                if spec.get("clean_first"):
+                    _clean_bucket_outputs(spec["out_dir"], bucket)
+                _check_fence(fence, group)
+                bucket_written = process_bucket(texts_by_bucket[bucket],
+                                                bucket)
+                _record_bucket_written(bucket_written, bucket)
+                written.update(bucket_written)
+            return written
+        own = writer is None
+        w = sink_mod.ShardWriter() if own else writer
+        try:
+            for bucket in sorted(texts_by_bucket):
+                if spec.get("clean_first"):
+                    _clean_bucket_outputs(spec["out_dir"], bucket)
+                _check_fence(fence, group)
+                publish = prepare(texts_by_bucket[bucket], bucket)
+                w.submit(group, _publish_task(publish, bucket), fence=fence)
+            w.end_unit(group)
+            if not own:
+                return sink_mod.DeferredUnit(group)
+            done = w.drain()
+        finally:
+            if own:
+                w.close()
+        _, written, exc = done[0]
+        if exc is not None:
+            raise exc  # incl. LeaseLost: the claim loop fences the unit
     return written
 
 
@@ -1062,22 +1191,47 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
             comm.barrier()
 
         factory = pool_factory_for(len(my_units))
-        with obs.span("preprocess.gather", rank=comm.rank,
-                      groups=len(my_units)):
-            results, failures = _run_units(
-                _pool_run_group if factory else
-                (lambda g: _run_group(spec, process_bucket, g)),
-                my_units, factory, log, "rank {} gather".format(comm.rank),
-                progress_interval=progress_interval,
-                on_result=lambda u, res: _ledger_write(out_dir, u, res))
+        # Cross-unit async sink (serial path only): one shard-writer
+        # thread pipelines unit N's parquet encode + fsync + publish
+        # against unit N+1's spool read / tokenize / mask. Pool workers
+        # instead pipeline within each unit via an own writer inside
+        # _run_group (results must drain before a future resolves, or
+        # the parent would journal bytes still in flight).
+        writer = (sink_mod.ShardWriter()
+                  if factory is None and hasattr(process_bucket, "prepare")
+                  else None)
+        try:
+            with obs.span("preprocess.gather", rank=comm.rank,
+                          groups=len(my_units)):
+                results, failures = _run_units(
+                    _pool_run_group if factory else
+                    (lambda g: _run_group(spec, process_bucket, g,
+                                          writer=writer)),
+                    my_units, factory, log,
+                    "rank {} gather".format(comm.rank),
+                    progress_interval=progress_interval,
+                    on_result=lambda u, res: _ledger_write(out_dir, u, res),
+                    writer=writer)
+        finally:
+            if writer is not None:
+                writer.close()
     else:
         factory = pool_factory_for(len(my_units))
-        results, failures = _run_units(
-            _pool_run_block_bucket if factory else
-            (lambda b: _run_block_bucket(spec, process_bucket, b)),
-            my_units, factory, log, "rank {} process".format(comm.rank),
-            progress_interval=progress_interval,
-            on_result=lambda u, res: _ledger_write(out_dir, u, res))
+        writer = (sink_mod.ShardWriter()
+                  if factory is None and hasattr(process_bucket, "prepare")
+                  else None)
+        try:
+            results, failures = _run_units(
+                _pool_run_block_bucket if factory else
+                (lambda b: _run_block_bucket(spec, process_bucket, b,
+                                             writer=writer)),
+                my_units, factory, log, "rank {} process".format(comm.rank),
+                progress_interval=progress_interval,
+                on_result=lambda u, res: _ledger_write(out_dir, u, res),
+                writer=writer)
+        finally:
+            if writer is not None:
+                writer.close()
 
     for res in results.values():
         written.update(res)

@@ -1,0 +1,273 @@
+"""Asynchronous durable shard sink: the double-buffered writer thread.
+
+PROFILE_PREPROCESS.json (post-PR 9) shows ~40% of single-worker preprocess
+wall inside the durable sink — parquet encode + fsync + atomic publish +
+spool IO — executed *serially between buckets*: tokenize bucket N+1 waits
+for bucket N's bytes to hit stable storage. This module takes the sink off
+the critical path: a :class:`ShardWriter` owns ONE writer thread and a
+bounded queue (depth 2 by default — classic double buffering), and the
+producer hands it *deferred publish closures* instead of writing inline.
+While the writer encodes/fsyncs/publishes bucket N, the producer
+tokenizes and masks bucket N+1; parquet encode, lz4, fsync and the file
+writes all release the GIL, so the overlap is real even in one process.
+
+Invariants (the writer is pure *deferred execution* of the existing
+``resilience.io`` publish path — nothing about WHAT is written changes):
+
+- **Byte identity.** Closures run in FIFO submit order on a single
+  thread, so shard bytes, file names and manifests are identical to a
+  serial run (pinned by tests/test_sink.py across binned / packed / BART
+  / schema-v1 golden configs).
+- **Atomic publish.** Closures call ``write_table_atomic`` /
+  ``atomic_write`` like the inline path; the analyzer's publish-path-flow
+  rule models the submit boundary (enqueue -> deferred call) so a raw
+  ``pq.write_table`` laundered through :meth:`ShardWriter.submit` is
+  still flagged (lddl_tpu/analysis/dataflow.py DEFERRED_CALL_MODULES).
+- **Fencing.** In elastic mode every deferred closure carries the unit's
+  lease fence; the writer re-checks it (``leases.verify_at`` via the
+  fence closure) immediately before executing the deferred publish — not
+  just at enqueue time — so a holder whose lease was stolen between
+  tokenize and publish self-terminates instead of publishing.
+- **Errors fail the unit loudly.** A closure that raises (injected
+  ``eio``/``truncate`` faults included — ``resilience.faults`` sites fire
+  on the writer thread) marks its unit failed, remaining closures of that
+  unit are skipped, and the failure surfaces to the producer at the next
+  ``completed()``/``drain()`` — always BEFORE the unit's ledger record is
+  written, so a resume redoes the unit. Later units are unaffected
+  (per-unit fault isolation, as in the inline path).
+- **Journal ordering.** Unit ledger records (and the elastic claim
+  loop's fence-checked journal publish) are written only after the
+  writer drained that unit's closures: ``_run_group`` drains its own
+  writer before returning, and the static serial path journals from
+  ``completed()``/``drain()`` results only.
+
+Knobs and telemetry::
+
+    LDDL_TPU_SINK_DEPTH   queue depth (default 2; 0 disables the thread —
+                          closures then run inline, byte-identical)
+    preprocess_sink_queue_depth          gauge: queued tasks high-water
+    preprocess_sink_stall_seconds_total  counter: producer seconds blocked
+                                         on a full queue or final drain
+    preprocess_sink_write_seconds_total  counter: writer seconds inside
+                                         deferred publish closures
+"""
+
+import os
+import queue
+import threading
+import time
+
+from .. import observability as obs
+from ..resilience import faults
+
+_END = object()  # end-of-unit marker sentinel
+
+DEFAULT_DEPTH = 2
+
+# Process-local aggregate stats (monotonic-clock durations only — never
+# shard bytes): read by benchmarks/profile_preprocess.py to embed the
+# sink-overlap block in PROFILE_PREPROCESS.json even when the metrics
+# registry is not armed.
+_STATS_LOCK = threading.Lock()
+_STATS = {"write_s": 0.0, "stall_s": 0.0, "tasks": 0, "units": 0,
+          "failed_units": 0}
+
+
+def stats_snapshot():
+    """Copy of the process-cumulative sink stats (profiling aid)."""
+    with _STATS_LOCK:
+        return dict(_STATS)
+
+
+def _stats_add(**deltas):
+    with _STATS_LOCK:
+        for k, v in deltas.items():
+            _STATS[k] += v
+
+
+def sink_depth():
+    """The configured queue depth; 0 means "run closures inline" (the
+    serial reference behavior — tests pin async == inline bytes)."""
+    try:
+        return max(0, int(os.environ.get("LDDL_TPU_SINK_DEPTH",
+                                         DEFAULT_DEPTH)))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+class DeferredUnit:
+    """Sentinel a unit function returns when its writes (and therefore
+    its result dict) will materialize on the shard writer: the unit
+    completes at a later ``completed()``/``drain()`` call."""
+
+    __slots__ = ("unit",)
+
+    def __init__(self, unit):
+        self.unit = unit
+
+
+class ShardWriter:
+    """One writer thread + bounded FIFO queue of deferred publish tasks.
+
+    Producer API (single producer thread):
+        ``submit(unit, fn, fence=None)``  enqueue one deferred publish;
+            ``fn() -> {path: rows}`` accumulates into the unit's result.
+        ``end_unit(unit)``  mark the unit's last task as enqueued.
+        ``completed()``  -> [(unit, written, exc)] units finished SO FAR.
+        ``drain()``  block until the queue is empty, then ``completed()``.
+        ``close()``  stop the thread (idempotent; call from ``finally``).
+    """
+
+    def __init__(self, depth=None, name="shard-sink"):
+        self.depth = sink_depth() if depth is None else max(0, int(depth))
+        self._inline = self.depth == 0
+        self._queue = None
+        self._thread = None
+        self._lock = threading.Lock()
+        self._open = {}   # unit -> {"written": dict, "exc": Exception|None}
+        self._done = []   # [(unit, written, exc)] awaiting collection
+        self._order = []  # units in end_unit order (completion order)
+        if not self._inline:
+            self._queue = queue.Queue(maxsize=self.depth)
+            self._thread = threading.Thread(
+                target=self._run, name=name, daemon=True)
+            self._thread.start()
+
+    # ------------------------------------------------------------ producer
+
+    def submit(self, unit, fn, fence=None):
+        state = self._open.setdefault(unit,
+                                      {"written": {}, "exc": None})
+        task = (unit, fn, fence)
+        if self._inline:
+            self._execute(state, task)
+            return
+        self._put(task)
+
+    def end_unit(self, unit):
+        state = self._open.setdefault(unit,
+                                      {"written": {}, "exc": None})
+        if self._inline:
+            self._finish(unit, state)
+            return
+        self._put((unit, _END, None))
+
+    def completed(self):
+        """Units whose last task finished since the previous call, in
+        completion (== submit) order. Thread-safe pop."""
+        with self._lock:
+            done, self._done = self._done, []
+        return done
+
+    def drain(self):
+        """Block until every enqueued task ran; return ``completed()``.
+        Producer stall time (the tail the overlap could not hide) is
+        accounted to ``preprocess_sink_stall_seconds_total``."""
+        if not self._inline:
+            t0 = time.monotonic()
+            self._queue.join()
+            self._note_stall(time.monotonic() - t0)
+        return self.completed()
+
+    def close(self):
+        if self._thread is not None:
+            self._queue.join()
+            self._queue.put(None)  # thread shutdown sentinel
+            self._thread.join()
+            self._thread = None
+
+    def _put(self, task):
+        q = self._queue
+        if obs.enabled():
+            obs.set_gauge("preprocess_sink_queue_depth", q.qsize() + 1)
+        try:
+            q.put_nowait(task)
+            return
+        except queue.Full:
+            pass
+        t0 = time.monotonic()
+        q.put(task)  # blocks: this is the double-buffer back-pressure
+        self._note_stall(time.monotonic() - t0)
+
+    def _note_stall(self, seconds):
+        if seconds <= 0:
+            return
+        _stats_add(stall_s=seconds)
+        if obs.enabled():
+            obs.inc("preprocess_sink_stall_seconds_total", seconds)
+
+    # ------------------------------------------------------- writer thread
+
+    def _run(self):
+        while True:
+            task = self._queue.get()
+            if task is None:
+                self._queue.task_done()
+                return
+            unit = task[0]
+            state = self._open.get(unit)
+            try:
+                if task[1] is _END:
+                    self._finish(unit, state)
+                else:
+                    self._execute(state, task)
+            # Defense in depth: _execute/_finish catch their own errors,
+            # but the writer thread must NEVER die with tasks queued —
+            # queue.join() in drain()/close() would deadlock the
+            # producer with no diagnostic. Anything unforeseen becomes a
+            # completed-with-error unit instead.
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    self._done.append((unit, {}, e))
+            finally:
+                self._queue.task_done()
+
+    def _execute(self, state, task):
+        unit, fn, fence = task
+        if state["exc"] is not None:
+            return  # unit already failed: skip its remaining publishes
+        t0 = time.monotonic()
+        try:
+            # Chaos site for "mid-deferred-publish" fault placement
+            # (tests park eio/stall/kill here); the closure's own
+            # resilience.io calls carry the regular open/replace sites.
+            faults.fault_point("sink-write", str(unit))
+            if fence is not None and not fence():
+                from ..resilience.leases import LeaseLost
+                raise LeaseLost(
+                    "unit {} was stolen before its deferred publish; "
+                    "self-terminating".format(unit))
+            res = fn()
+            if res:
+                state["written"].update(res)
+        except Exception as e:  # noqa: BLE001 - surfaces at the producer
+            state["exc"] = e
+        finally:
+            _stats_add(write_s=time.monotonic() - t0, tasks=1)
+            if obs.enabled():
+                obs.inc("preprocess_sink_write_seconds_total",
+                        time.monotonic() - t0)
+
+    def _finish(self, unit, state):
+        if state is None:
+            # Unmatched/duplicate end_unit: a caller bug, but it must
+            # surface as a loud unit failure, not kill the writer thread
+            # (which would deadlock the producer's queue.join()).
+            state = {"written": {}, "exc": RuntimeError(
+                "unmatched end_unit for {!r} (no open unit)".format(unit))}
+        self._open.pop(unit, None)
+        _stats_add(units=1,
+                   failed_units=1 if state["exc"] is not None else 0)
+        with self._lock:
+            self._done.append((unit, state["written"], state["exc"]))
+
+
+def collect_into(done, record, record_failure):
+    """Route ``completed()`` tuples into the runner's per-unit result /
+    failure recorders (the unit is journaled by ``record`` only here —
+    i.e. only after its writes drained)."""
+    for unit, written, exc in done:
+        if exc is None:
+            record(unit, written)
+        else:
+            record_failure(unit, "{}: {}".format(type(exc).__name__, exc))

@@ -16,8 +16,8 @@ Spec grammar — comma-separated clauses of colon-separated fields::
                [:path=<substr>][:delay=<float>][:flag=<file>]
 
     op    site name: open | read | replace | worker | lease-acquire |
-          lease-renew | lease-release | journal-read | journal-publish
-          (or * for any site)
+          lease-renew | lease-release | journal-read | journal-publish |
+          sink-write (or * for any site)
     kind  eio | estale | truncate | slow | stall | kill
     p     per-call injection probability (seeded per process)
     nth   inject on exactly the Nth matching call of this process
@@ -38,6 +38,14 @@ Examples::
                                                         # force a steal
     LDDL_TPU_FAULTS="journal-read:truncate:nth=1"  # torn ingest-journal
                                                    # cache -> segment rescan
+    LDDL_TPU_FAULTS="sink-write:kill:nth=2"  # SIGKILL on the shard-writer
+                                             # thread mid-deferred-publish
+
+The ``sink-write`` site fires on the async shard-writer THREAD
+(preprocess/sink.py), immediately before each deferred publish closure
+runs — chaos coverage for the enqueue->publish window the double
+buffer opens (an eio there must fail the unit loudly at the producer; a
+kill must leave only ``*.tmp.*`` debris + an unjournaled unit).
 """
 
 import errno

@@ -305,11 +305,14 @@ def read_table(path, retries=True):
     return _read()
 
 
-def write_table_atomic(table, path, compression=None, retries=True):
+def write_table_atomic(table, path, compression=None, retries=True,
+                       **write_options):
     """Write a pyarrow table via tmp + fsync + replace, so a crashed or
     preempted writer can never publish a torn shard under its final name
     (half-written ``part.*.parquet`` files were previously possible and
-    poisoned downstream stages)."""
+    poisoned downstream stages). ``write_options`` pass through to
+    ``pq.write_table`` (the v2/packed sinks pin their page layout via
+    binning.V2_PARQUET_WRITE_OPTIONS)."""
     import pyarrow.parquet as pq
 
     tmp = "{}.tmp.{}".format(path, os.getpid())
@@ -317,7 +320,8 @@ def write_table_atomic(table, path, compression=None, retries=True):
     def _write():
         faults.fault_point("open", path)
         try:
-            pq.write_table(table, tmp, compression=compression)
+            pq.write_table(table, tmp, compression=compression,
+                           **write_options)
             atomic_publish(tmp, path)
         finally:
             if os.path.exists(tmp):

@@ -385,6 +385,88 @@ def test_publish_path_flow_transitive_chain(tmp_path):
     assert "write_text" in f.message
 
 
+def test_publish_path_flow_through_async_sink_submit(tmp_path):
+    """The writer-thread boundary cannot launder a raw write: a raw-
+    writing helper handed to ``writer.submit(...)`` (deferred execution
+    on the sink thread) is treated as called at the enqueue site, so the
+    publish-path rule still fires in the enqueuing shard-package
+    function."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/utils/rawio.py": """
+            def raw_dump():
+                with open("/out/x.parquet", "w") as f:
+                    f.write("bytes")
+        """,
+        "lddl_tpu/preprocess/sink.py": """
+            class ShardWriter:
+                def __init__(self):
+                    self._q = []
+
+                def submit(self, unit, fn, fence=None):
+                    self._q.append((unit, fn, fence))
+        """,
+        "lddl_tpu/preprocess/runner.py": """
+            from ..utils.rawio import raw_dump
+            from .sink import ShardWriter
+
+            def gather(out_dir, rows):
+                writer = ShardWriter()
+                writer.submit(7, raw_dump)
+        """,
+    }, rules=["publish-path-flow"])
+    [f] = flow_findings(report, "publish-path-flow")
+    assert f.path == "lddl_tpu/preprocess/runner.py"
+    assert "raw_dump" in f.message
+
+
+def test_publish_path_flow_async_sink_lambda_argument(tmp_path):
+    """A lambda enqueued on the sink is walked at the enqueue site: the
+    raw write reached through its body is attributed to the enqueuing
+    function."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/utils/rawio.py": """
+            def raw_dump(path):
+                with open(path, "w") as f:
+                    f.write("bytes")
+        """,
+        "lddl_tpu/preprocess/runner.py": """
+            from ..utils.rawio import raw_dump
+
+            def gather(writer, out_dir):
+                writer.submit(7, lambda: raw_dump(out_dir + "/x.parquet"))
+        """,
+    }, rules=["publish-path-flow"])
+    [f] = flow_findings(report, "publish-path-flow")
+    assert f.path == "lddl_tpu/preprocess/runner.py"
+    assert "raw_dump" in f.message
+
+
+def test_publish_path_flow_async_sink_clean_closure_is_silent(tmp_path):
+    """The sanctioned pattern — a deferred closure publishing through
+    resilience.io — stays silent across the submit boundary."""
+    report = run_tree(tmp_path, {
+        "lddl_tpu/resilience/io.py": """
+            import os
+
+            def write_table_atomic(table, path):
+                tmp = path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(table)
+                os.replace(tmp, path)
+        """,
+        "lddl_tpu/preprocess/runner.py": """
+            from ..resilience.io import write_table_atomic
+
+            def publish_shard():
+                write_table_atomic(b"t", "/out/part.0.parquet")
+
+            def gather(writer):
+                writer.submit(7, publish_shard)
+        """,
+    }, rules=["publish-path-flow"])
+    assert flow_findings(report) == []
+
+
 def test_publish_path_flow_atomic_publisher_is_sanctioned(tmp_path):
     """Calling through resilience.io is THE sanctioned path: no finding,
     even though io.py internally write-opens and os.replaces."""

@@ -145,6 +145,132 @@ def test_fused_matches_staged_arrays(hf_tokenizer):
         assert len(b_ids) == len(seq_ids) - len(a_ids) - 3 * len(seq_lens)
 
 
+def _expected_masked_arrays(info, texts, config_kw, seed, bucket, scope):
+    """Ground truth for the fused-masked kernel: the staged path run
+    entirely through the NUMPY masking engine (fused instances + padded
+    matrix + mask_batch_numpy), re-deriving exactly the flat arrays
+    materialize_columns' masking branch gathers."""
+    from lddl_tpu.preprocess.arrowcols import concat_aranges
+    from lddl_tpu.preprocess.bert import (BertPretrainConfig, InstanceBatch,
+                                          apply_static_masking)
+    cfg = BertPretrainConfig(**config_kw)
+    nat = info.native_tokenizer()
+    seq_ids, seq_lens, a_lens, rn, _, _ = nat.bert_instances(
+        texts, cfg.max_seq_length, cfg.short_seq_prob, cfg.duplicate_factor,
+        seed, bucket, info.cls_id, info.sep_id)
+    batch = InstanceBatch(seq_ids, seq_lens, a_lens, rn)
+    prior = os.environ.get("LDDL_TPU_NATIVE_MASK")
+    os.environ["LDDL_TPU_NATIVE_MASK"] = "0"  # force the numpy reference
+    try:
+        masked, selected, ids, a_lens, seq_lens = apply_static_masking(
+            batch, cfg, info, seed, scope)
+    finally:
+        if prior is None:
+            del os.environ["LDDL_TPU_NATIVE_MASK"]
+        else:
+            os.environ["LDDL_TPU_NATIVE_MASK"] = prior
+    n = len(seq_lens)
+    a_lens = np.asarray(a_lens, dtype=np.int64)
+    seq_lens = np.asarray(seq_lens, dtype=np.int64)
+    b_lens = seq_lens - a_lens - 3
+    rows = np.arange(n, dtype=np.int64)
+    flat_a = masked[np.repeat(rows, a_lens), 1 + concat_aranges(a_lens)]
+    flat_b = masked[np.repeat(rows, b_lens),
+                    np.repeat(2 + a_lens, b_lens) + concat_aranges(b_lens)]
+    sel_rows, sel_cols = np.nonzero(selected)
+    sel_lens = np.bincount(sel_rows, minlength=n)
+    return (a_lens, seq_lens, np.asarray(rn, bool), flat_a, flat_b,
+            sel_cols, sel_lens, ids[sel_rows, sel_cols])
+
+
+def test_fused_masked_matches_numpy_replay(hf_tokenizer):
+    """lddl_bert_instances_masked is a bit-exact replay of the staged
+    numpy path: same instances, same Philox selections, same 80/10/10
+    replacements, same row-relative positions and labels — across
+    seq-length/ratio shapes."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d for d in DOCS if d.strip()] * 4
+    for seed, bucket, msl, ratio, mp in [(7, 0, 48, 0.15, None),
+                                         (12345, 3, 128, 0.15, None),
+                                         (9, 1, 48, 0.4, 5)]:
+        kw = dict(max_seq_length=msl, duplicate_factor=2,
+                  masking=True, masked_lm_ratio=ratio)
+        if mp is not None:
+            kw["max_predictions_per_seq"] = mp
+        from lddl_tpu.preprocess.bert import BertPretrainConfig
+        cfg = BertPretrainConfig(**kw)
+        scope = (0x3A5C, bucket)
+        got = nat.bert_instances_masked(
+            texts, cfg.max_seq_length, cfg.short_seq_prob,
+            cfg.duplicate_factor, seed, bucket, info.cls_id, info.sep_id,
+            lrng.sample_key_bytes(seed, *scope), info.mask_id,
+            info.vocab_size, cfg.masked_lm_ratio,
+            cfg.max_predictions_per_seq, min(128, cfg.max_seq_length))
+        assert got is not None
+        ref = _expected_masked_arrays(info, texts, kw, seed, bucket, scope)
+        names = ("a_lens", "seq_lens", "is_random_next", "flat_a",
+                 "flat_b", "sel_positions", "sel_lens", "label_ids")
+        for name, g_arr, r_arr in zip(names, got, ref):
+            np.testing.assert_array_equal(np.asarray(g_arr),
+                                          np.asarray(r_arr), err_msg=name)
+
+
+def test_fused_masked_out_of_contract_refuses_into_ladder(hf_tokenizer,
+                                                          monkeypatch):
+    """masked_instances_from_texts must return None — never a diverging
+    engine fork — for every parameter outside the frozen replay contract
+    (wwm, jax engine, out-of-range vocab, force-disable env)."""
+    from lddl_tpu.preprocess.bert import (BertPretrainConfig,
+                                          masked_instances_from_texts)
+    info = TokenizerInfo(hf_tokenizer)
+    texts = [d for d in DOCS if d.strip()]
+    base = dict(max_seq_length=48, duplicate_factor=1, masking=True)
+
+    def attempt(cfg):
+        return masked_instances_from_texts(texts, info, cfg, 7, 0,
+                                           (0x3A5C, 0))
+
+    assert attempt(BertPretrainConfig(**base)) is not None
+    assert attempt(BertPretrainConfig(whole_word_masking=True,
+                                      **base)) is None
+    assert attempt(BertPretrainConfig(engine="jax", **base)) is None
+    assert attempt(BertPretrainConfig(masking=False, max_seq_length=48,
+                                      duplicate_factor=1)) is None
+    monkeypatch.setattr(info, "vocab_size", 2**33)
+    assert attempt(BertPretrainConfig(**base)) is None
+    monkeypatch.undo()
+    monkeypatch.setenv("LDDL_TPU_NATIVE_FUSED_MASK", "0")
+    assert attempt(BertPretrainConfig(**base)) is None
+    monkeypatch.delenv("LDDL_TPU_NATIVE_FUSED_MASK")
+    # The global "no C++ masking" triage knob must drop this rung too.
+    monkeypatch.setenv("LDDL_TPU_NATIVE_MASK", "0")
+    assert attempt(BertPretrainConfig(**base)) is None
+    monkeypatch.delenv("LDDL_TPU_NATIVE_MASK")
+    monkeypatch.setenv("LDDL_TPU_NATIVE_FUSED", "0")
+    assert attempt(BertPretrainConfig(**base)) is None
+
+
+def test_fused_masked_identity_across_mask_ladder(hf_tokenizer, corpus_dir,
+                                                  tmp_path, monkeypatch):
+    """Shard bytes are identical whether masking ran fused in-kernel,
+    staged native (lddl_mask_batch), or pure numpy — the masking ladder
+    is an implementation swap all the way down."""
+    fused_mask = _run_bert(corpus_dir, str(tmp_path / "fm"), hf_tokenizer,
+                           monkeypatch, bin_size=16)
+    staged_mask = _run_bert(corpus_dir, str(tmp_path / "sm"), hf_tokenizer,
+                            monkeypatch,
+                            env={"LDDL_TPU_NATIVE_FUSED_MASK": "0"},
+                            bin_size=16)
+    numpy_mask = _run_bert(corpus_dir, str(tmp_path / "nm"), hf_tokenizer,
+                           monkeypatch,
+                           env={"LDDL_TPU_NATIVE_FUSED_MASK": "0",
+                                "LDDL_TPU_NATIVE_MASK": "0"},
+                           bin_size=16)
+    assert fused_mask == staged_mask == numpy_mask
+    assert fused_mask
+
+
 def test_fused_accepts_doc_spans(hf_tokenizer):
     """DocSpans input (the zero-copy spool view) tokenizes identically to
     the packed list path, including after an offset-array shuffle."""

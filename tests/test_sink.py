@@ -1,0 +1,334 @@
+"""Async durable sink (preprocess/sink.py): byte identity, fault and
+chaos coverage for the double-buffered shard-writer thread.
+
+The writer is pure deferred execution of the existing resilience.io
+publish path, so every pin here is an equality: serial (depth 0) and
+async (any depth) runs must produce byte-identical shard trees and
+manifests across binned / packed / BART / schema-v1-golden configs;
+faults injected INSIDE the writer thread must fail the owning unit
+loudly before it is journaled; and a SIGKILL mid-deferred-publish must
+resume to byte identity with a clean run.
+"""
+
+import hashlib
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu.preprocess import sink  # noqa: E402
+from lddl_tpu.resilience import faults  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("sink")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    return str(td), corpus, vocab
+
+
+def _tree_digest(out_dir):
+    """{relative name: sha256} over every published file (shards, txt,
+    .manifest.json, .num_samples.json) — manifests are part of the
+    byte-identity contract."""
+    digests = {}
+    for root, dirs, files in os.walk(out_dir):
+        dirs.sort()
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, out_dir)
+            with open(path, "rb") as f:
+                digests[rel] = hashlib.sha256(f.read()).hexdigest()
+    return digests
+
+
+def _run_bert(corpus, vocab, out, depth=None, monkeypatch=None, **kw):
+    from lddl_tpu.preprocess import (BertPretrainConfig, get_tokenizer,
+                                     run_bert_preprocess)
+    if depth is not None:
+        monkeypatch.setenv("LDDL_TPU_SINK_DEPTH", str(depth))
+    try:
+        cfg_kw = kw.pop("config_kw", {})
+        cfg = BertPretrainConfig(max_seq_length=32, masking=True, **cfg_kw)
+        run_bert_preprocess(
+            {"wikipedia": corpus}, out, get_tokenizer(vocab_file=vocab),
+            config=cfg, num_blocks=8, sample_ratio=0.9, seed=4242,
+            progress_interval=0.0, **kw)
+    finally:
+        if depth is not None:
+            monkeypatch.delenv("LDDL_TPU_SINK_DEPTH", raising=False)
+    return _tree_digest(out)
+
+
+def test_async_vs_serial_byte_identity_binned(fixture_dirs, tmp_path,
+                                              monkeypatch):
+    """Binned masked schema-v2 shards + manifest: depth 0 (inline), the
+    default depth 2, and a deep queue are all byte-identical."""
+    _, corpus, vocab = fixture_dirs
+    serial = _run_bert(corpus, vocab, str(tmp_path / "serial"), depth=0,
+                       monkeypatch=monkeypatch, bin_size=8)
+    async2 = _run_bert(corpus, vocab, str(tmp_path / "async2"), depth=2,
+                       monkeypatch=monkeypatch, bin_size=8)
+    async8 = _run_bert(corpus, vocab, str(tmp_path / "async8"), depth=8,
+                       monkeypatch=monkeypatch, bin_size=8)
+    assert serial == async2 == async8
+    assert any(n.endswith(".manifest.json") for n in serial)
+    assert any("parquet" in n for n in serial)
+
+
+def test_async_vs_serial_byte_identity_packed(fixture_dirs, tmp_path,
+                                              monkeypatch):
+    """The offline-packed sink (FFD inside the deferred closure) is
+    byte-identical serial vs async."""
+    _, corpus, vocab = fixture_dirs
+    kw = dict(pack_seq_length=64, pack_max_per_row=4)
+    serial = _run_bert(corpus, vocab, str(tmp_path / "serial"), depth=0,
+                       monkeypatch=monkeypatch, **kw)
+    async2 = _run_bert(corpus, vocab, str(tmp_path / "async2"), depth=2,
+                       monkeypatch=monkeypatch, **kw)
+    assert serial == async2
+    assert any("parquet" in n for n in serial)
+
+
+def test_async_vs_serial_byte_identity_bart(fixture_dirs, tmp_path,
+                                            monkeypatch):
+    """BART (schema-v2: tokenizer-fed id columns) serial vs async."""
+    from lddl_tpu.preprocess import get_tokenizer
+    from lddl_tpu.preprocess.bart import (BartPretrainConfig,
+                                          run_bart_preprocess)
+    _, corpus, vocab = fixture_dirs
+
+    def run(out, depth):
+        monkeypatch.setenv("LDDL_TPU_SINK_DEPTH", str(depth))
+        try:
+            run_bart_preprocess(
+                {"wikipedia": corpus}, out,
+                config=BartPretrainConfig(target_seq_length=32),
+                num_blocks=8, sample_ratio=0.9, seed=4242,
+                progress_interval=0.0,
+                tokenizer=get_tokenizer(vocab_file=vocab))
+        finally:
+            monkeypatch.delenv("LDDL_TPU_SINK_DEPTH", raising=False)
+        return _tree_digest(out)
+
+    assert run(str(tmp_path / "serial"), 0) == run(str(tmp_path / "a2"), 2)
+
+
+def test_async_matches_schema_v1_golden(fixture_dirs, tmp_path,
+                                        monkeypatch):
+    """The pinned v1 golden-spool bytes survive the async sink — and the
+    v1 parquet layout itself is untouched by the v2 layout change."""
+    _, corpus, vocab = fixture_dirs
+    with open(gs.GOLDEN_FILE) as f:
+        goldens = json.load(f)
+    monkeypatch.setenv("LDDL_TPU_SINK_DEPTH", "2")
+    got_async = gs.run_case(corpus, vocab, str(tmp_path / "async"),
+                            binned=True)
+    monkeypatch.setenv("LDDL_TPU_SINK_DEPTH", "0")
+    got_serial = gs.run_case(corpus, vocab, str(tmp_path / "serial"),
+                             binned=True)
+    assert got_async == got_serial == goldens["binned_masked"]
+
+
+def test_writer_thread_eio_fails_unit_loudly(fixture_dirs, tmp_path,
+                                             monkeypatch):
+    """An eio at the sink-write site (fires ON the writer thread) fails
+    the owning unit: the run raises naming failed units, the failed
+    unit is NOT journaled, and a resume completes to byte identity."""
+    _, corpus, vocab = fixture_dirs
+    clean = _run_bert(corpus, vocab, str(tmp_path / "clean"),
+                      bin_size=8)
+    out = str(tmp_path / "out")
+    faults.arm("sink-write:eio:nth=2")
+    try:
+        with pytest.raises(RuntimeError, match="preprocess failed"):
+            _run_bert(corpus, vocab, out, bin_size=8)
+    finally:
+        faults.disarm()
+    ledger = os.path.join(out, "_done")
+    records = [n for n in sorted(os.listdir(ledger))
+               if n.startswith("group-")]
+    assert 0 < len(records) < 8  # healthy units journaled, failed one not
+    got = _run_bert(corpus, vocab, out, bin_size=8, resume=True)
+    assert got == clean
+    assert not [n for n in got if ".tmp." in n]  # debris swept
+
+
+def test_writer_thread_io_eio_exhaustion_fails_unit(fixture_dirs, tmp_path,
+                                                    monkeypatch):
+    """eio injected at the resilience.io open site of the deferred
+    write_table_atomic (every attempt, so retries exhaust) surfaces as a
+    loud unit failure at the producer — never a silent drop."""
+    _, corpus, vocab = fixture_dirs
+    clean = _run_bert(corpus, vocab, str(tmp_path / "clean"), bin_size=8)
+    out = str(tmp_path / "out")
+    monkeypatch.setenv("LDDL_TPU_RETRY_ATTEMPTS", "2")
+    monkeypatch.setenv("LDDL_TPU_RETRY_BASE_DELAY_S", "0.01")
+    faults.arm("open:eio:p=1:path=part.3.")
+    try:
+        with pytest.raises(RuntimeError, match="preprocess failed"):
+            _run_bert(corpus, vocab, out, bin_size=8)
+    finally:
+        faults.disarm()
+    monkeypatch.delenv("LDDL_TPU_RETRY_ATTEMPTS")
+    monkeypatch.delenv("LDDL_TPU_RETRY_BASE_DELAY_S")
+    got = _run_bert(corpus, vocab, out, bin_size=8, resume=True)
+    assert got == clean
+    assert not [n for n in got if ".tmp." in n]
+
+
+_KILL_SCRIPT = """
+import os, sys
+sys.path.insert(0, {repo!r})
+sys.path.insert(0, {tests!r})
+import golden_spool as gs
+from lddl_tpu.preprocess import (BertPretrainConfig, get_tokenizer,
+                                 run_bert_preprocess)
+run_bert_preprocess(
+    {{"wikipedia": {corpus!r}}}, {out!r},
+    get_tokenizer(vocab_file={vocab!r}),
+    config=BertPretrainConfig(max_seq_length=32, masking=True),
+    num_blocks=8, sample_ratio=0.9, seed=4242, bin_size=8,
+    progress_interval=0.0, resume={resume})
+"""
+
+
+def test_sigkill_mid_deferred_publish_resumes_to_byte_identity(
+        fixture_dirs, tmp_path, monkeypatch):
+    """THE chaos acceptance pin: a SIGKILL landing on the writer thread
+    mid-deferred-publish (after several units are already journaled)
+    kills the process uncleanly; a resume converges to a tree
+    byte-identical to an uninterrupted run, with no ``*.tmp.*`` debris
+    under any published name."""
+    _, corpus, vocab = fixture_dirs
+    clean = _run_bert(corpus, vocab, str(tmp_path / "clean"), bin_size=8)
+    out = str(tmp_path / "out")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LDDL_TPU_FAULTS": "sink-write:kill:nth=5:flag={}".format(
+            tmp_path / "killed.flag"),
+    })
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT.format(
+            repo=repo, tests=os.path.dirname(os.path.abspath(__file__)),
+            corpus=corpus, out=out, vocab=vocab, resume="False")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == -9, proc.stderr  # genuinely SIGKILLed
+    assert os.path.exists(str(tmp_path / "killed.flag"))
+    # Some units journaled before the kill, not all (mid-run death).
+    done = os.path.join(out, "_done")
+    journaled = [n for n in sorted(os.listdir(done))
+                 if n.startswith("group-")] if os.path.isdir(done) else []
+    assert len(journaled) < 8
+    proc = subprocess.run(
+        [sys.executable, "-c", _KILL_SCRIPT.format(
+            repo=repo, tests=os.path.dirname(os.path.abspath(__file__)),
+            corpus=corpus, out=out, vocab=vocab, resume="True")],
+        env={k: v for k, v in env.items() if k != "LDDL_TPU_FAULTS"},
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    got = _tree_digest(out)
+    assert got == clean
+    assert not [n for n in got if ".tmp." in n]
+
+
+def test_shard_writer_unit_isolation_and_error_at_collect(tmp_path):
+    """ShardWriter semantics: a closure that raises fails ONLY its unit
+    (remaining closures of that unit are skipped), later units complete,
+    and the failure surfaces at collect with the original exception."""
+    w = sink.ShardWriter(depth=2)
+    ran = []
+    try:
+        w.submit("u1", lambda: ran.append("a") or {"a": 1})
+        w.submit("u1", lambda: (_ for _ in ()).throw(OSError(5, "boom")))
+        w.submit("u1", lambda: ran.append("skipped") or {"c": 1})
+        w.end_unit("u1")
+        w.submit("u2", lambda: ran.append("b") or {"b": 2})
+        w.end_unit("u2")
+        done = {u: (written, exc) for u, written, exc in w.drain()}
+    finally:
+        w.close()
+    assert ran == ["a", "b"]  # post-failure closure of u1 skipped
+    written1, exc1 = done["u1"]
+    assert isinstance(exc1, OSError) and "boom" in str(exc1)
+    written2, exc2 = done["u2"]
+    assert exc2 is None and written2 == {"b": 2}
+
+
+def test_shard_writer_fence_rechecked_before_publish(tmp_path):
+    """The fence is re-checked ON the writer thread immediately before
+    each deferred publish: a fence that turns False after enqueue stops
+    the publish (LeaseLost), so a stolen unit cannot write late bytes."""
+    from lddl_tpu.resilience.leases import LeaseLost
+    state = {"held": True}
+    w = sink.ShardWriter(depth=2)
+    wrote = []
+    try:
+        state["held"] = False  # stolen between compute and publish
+        w.submit("u", lambda: wrote.append(1) or {"p": 1},
+                 fence=lambda: state["held"])
+        w.end_unit("u")
+        (unit, written, exc), = w.drain()
+    finally:
+        w.close()
+    assert wrote == [] and written == {}
+    assert isinstance(exc, LeaseLost)
+
+
+def test_shard_writer_unmatched_end_unit_fails_loudly_no_deadlock():
+    """A duplicate/unmatched end_unit is a caller bug, but it must
+    surface as a completed-with-error unit — never kill the writer
+    thread (a dead thread would deadlock drain()/close() on
+    queue.join() with no diagnostic)."""
+    w = sink.ShardWriter(depth=2)
+    try:
+        w.submit("u", lambda: {"a": 1})
+        w.end_unit("u")
+        w.end_unit("u")  # unmatched: no open unit anymore
+        w.submit("v", lambda: {"b": 2})
+        w.end_unit("v")
+        done = w.drain()  # must not hang
+    finally:
+        w.close()  # must not hang
+    assert [(u, written) for u, written, exc in done if exc is None] == \
+        [("u", {"a": 1}), ("v", {"b": 2})]
+    # The unmatched end surfaced as its own loud failure entry.
+    [bad] = [(u, exc) for u, written, exc in done if exc is not None]
+    assert bad[0] == "u" and "unmatched end_unit" in str(bad[1])
+
+
+def test_sink_depth_knob_and_inline_mode(monkeypatch):
+    """LDDL_TPU_SINK_DEPTH=0 disables the thread (closures run inline on
+    the producer); junk values fall back to the default depth."""
+    monkeypatch.setenv("LDDL_TPU_SINK_DEPTH", "0")
+    w = sink.ShardWriter()
+    assert w._thread is None
+    w.submit("u", lambda: {"x": 1})
+    w.end_unit("u")
+    (unit, written, exc), = w.drain()
+    assert written == {"x": 1} and exc is None
+    w.close()
+    monkeypatch.setenv("LDDL_TPU_SINK_DEPTH", "junk")
+    assert sink.sink_depth() == sink.DEFAULT_DEPTH
+    monkeypatch.delenv("LDDL_TPU_SINK_DEPTH")
+    assert sink.sink_depth() == sink.DEFAULT_DEPTH
+
+
+def test_sink_stats_accumulate(fixture_dirs, tmp_path, monkeypatch):
+    """The process-local overlap stats (profiler feed) grow with a run:
+    tasks == deferred publishes, units == completed units."""
+    _, corpus, vocab = fixture_dirs
+    before = sink.stats_snapshot()
+    _run_bert(corpus, vocab, str(tmp_path / "out"), bin_size=8)
+    after = sink.stats_snapshot()
+    assert after["tasks"] >= before["tasks"] + 8
+    assert after["units"] >= before["units"] + 8
+    assert after["write_s"] > before["write_s"]

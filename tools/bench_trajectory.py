@@ -92,6 +92,26 @@ def verdict(series):
     }
 
 
+def load_sink_overlap(repo_root):
+    """The async-sink overlap block from PROFILE_PREPROCESS.json (writer-
+    thread seconds vs producer stall — how much durable-sink work left
+    the critical path), or None when the artifact predates it."""
+    path = os.path.join(repo_root, "PROFILE_PREPROCESS.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    overlap = doc.get("sink_overlap")
+    if not isinstance(overlap, dict):
+        return None
+    out = dict(overlap)
+    out["producer_mb_per_s"] = doc.get("mb_per_s_single_worker")
+    prev = doc.get("previous") or {}
+    out["previous_mb_per_s"] = prev.get("mb_per_s_single_worker")
+    return out
+
+
 def load_loader_bench(repo_root):
     path = os.path.join(repo_root, "LOADER_BENCH.json")
     try:
@@ -136,6 +156,7 @@ def main(argv=None):
         "preprocess_mb_per_s": series,
         "preprocess_verdict": verdict(series),
         "loader": load_loader_bench(args.repo_root),
+        "sink_overlap": load_sink_overlap(args.repo_root),
     }
     if args.json:
         print(json.dumps(result, indent=2, sort_keys=True))
@@ -182,6 +203,22 @@ def main(argv=None):
                                            v["pad_loadtime"])
             for k, v in sorted(
                 loader["packed_offline_over_loadtime"].items())))
+    overlap = result["sink_overlap"]
+    if overlap:
+        line = ("async sink overlap (PROFILE_PREPROCESS): depth={depth}, "
+                "{tasks} deferred publishes over {units} units, writer "
+                "{write}s off the critical path, producer stalled "
+                "{stall}s").format(
+                    depth=overlap.get("async_depth"),
+                    tasks=overlap.get("deferred_publishes"),
+                    units=overlap.get("units"),
+                    write=overlap.get("writer_write_s"),
+                    stall=overlap.get("producer_stall_s"))
+        if overlap.get("producer_mb_per_s") is not None \
+                and overlap.get("previous_mb_per_s") is not None:
+            line += "; single-worker {} -> {} MB/s".format(
+                overlap["previous_mb_per_s"], overlap["producer_mb_per_s"])
+        print(line)
     return 0
 
 

@@ -58,6 +58,15 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
     else
         echo "ci_check: loader_bench smoke FAILED (non-gating, ignored)" >&2
     fi
+    # Async-sink serial-vs-async smoke pair: the timing is informational,
+    # but the script itself asserts serial/async byte identity and exits
+    # nonzero on divergence — that half IS a correctness alarm.
+    if JAX_PLATFORMS=cpu python benchmarks/sink_smoke.py; then
+        echo "ci_check: sink serial-vs-async smoke pair OK (timing non-gating)"
+    else
+        echo "ci_check: sink smoke FAILED — serial/async divergence or crash" >&2
+        exit 1
+    fi
 fi
 
 # Opt-in native-engine smoke: builds the C++ engine from source and runs
