@@ -1,0 +1,146 @@
+"""CI smoke pair for elastic coordination: 2 worksteal processes on one
+small corpus, legacy vs batched coordination — byte identity asserted,
+lease-op ratio reported.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_BENCH=1``. The
+byte-identity half is GATING (the coordination protocol must never show
+up in shard bytes — the same invariant the chaos suite pins — so a
+divergence exits nonzero); the lease-ops-per-unit ratio half is
+informational (a 2-process minute-long smoke on a busy CI box is
+weather; the committed SCALE_RUN.json phase 7 is the measurement of
+record). Prints one JSON line::
+
+    {"identical": true, "ops_per_unit": {"legacy": ..., "batched": ...},
+     "ops_per_unit_ratio": ..., "units": {...}, "wall_s": {...},
+     "host_can_show_scaling": false}
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+
+def _parquet_digests(out_dir):
+    out = {}
+    for name in sorted(os.listdir(out_dir)):
+        if ".parquet" in name and ".tmp." not in name:
+            h = hashlib.sha256()
+            with open(os.path.join(out_dir, name), "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[name] = h.hexdigest()
+    return out
+
+
+def _counter(out_dir, metric, label=None):
+    """Sum a counter across every host's telemetry spool snapshots
+    (per-holder merge: the newest pid snapshot per holder dir already
+    carries that process's full counts)."""
+    total = 0
+    tel = os.path.join(out_dir, ".telemetry")
+    if not os.path.isdir(tel):
+        return total
+    for holder in sorted(os.listdir(tel)):
+        d = os.path.join(tel, holder)
+        if not os.path.isdir(d):
+            continue
+        merged = {}
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("snapshot-pid")
+                    and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(d, name), encoding="utf-8") as f:
+                    snap = json.load(f)
+            except (OSError, ValueError):
+                continue
+            values = ((snap.get("metrics") or {}).get(metric)
+                      or {}).get("values") or {}
+            for k, v in values.items():
+                merged[k] = merged.get(k, 0) + v
+        total += sum(v for k, v in merged.items()
+                     if label is None or k == label)
+    return total
+
+
+def main():
+    target_mb = float(os.environ.get("LDDL_TPU_ELASTIC_SMOKE_MB", "2"))
+    tmp = tempfile.mkdtemp(prefix="lddl_elastic_smoke_")
+    try:
+        from lddl_tpu.preprocess import build_wordpiece_vocab
+
+        corpus = os.path.join(tmp, "corpus")
+        bench.make_corpus(corpus, target_mb, seed=0)
+        sample = []
+        sample_bytes = 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 300_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=8000)
+
+        def cli(sink, holder):
+            return [sys.executable, "-m",
+                    "lddl_tpu.cli.preprocess_bert_pretrain",
+                    "--wikipedia", corpus, "--sink", sink,
+                    "--vocab-file", vocab, "--masking",
+                    "--bin-size", "32", "--num-blocks", "16",
+                    "--seed", "7", "--local-workers", "1",
+                    "--elastic", "--lease-ttl", "5",
+                    "--elastic-host-id", holder, "--fleet-telemetry"]
+
+        report = {"ops_per_unit": {}, "ops_per_unit_ratio": None,
+                  "units": {}, "wall_s": {},
+                  "host_can_show_scaling": (os.cpu_count() or 1) >= 4}
+        digests = {}
+        for mode, env_extra in (("legacy", {"LDDL_TPU_COORD_LEGACY": "1"}),
+                                ("batched", {})):
+            sink = os.path.join(tmp, mode)
+            env = dict(os.environ, JAX_PLATFORMS="cpu", **env_extra)
+            t0 = time.perf_counter()
+            procs = [subprocess.Popen(cli(sink, "s{}".format(i)), env=env,
+                                      stdout=subprocess.DEVNULL,
+                                      stderr=subprocess.STDOUT)
+                     for i in range(2)]
+            rcs = [p.wait(timeout=1200) for p in procs]
+            report["wall_s"][mode] = round(time.perf_counter() - t0, 1)
+            if rcs != [0, 0]:
+                print("elastic smoke: {} leg failed rc={}".format(
+                    mode, rcs), file=sys.stderr)
+                return 1
+            ops = _counter(sink, "lease_ops_total")
+            units = _counter(sink, "elastic_units_completed_total")
+            report["units"][mode] = units
+            report["ops_per_unit"][mode] = round(ops / max(units, 1), 2)
+            digests[mode] = _parquet_digests(sink)
+        report["identical"] = (digests["legacy"] == digests["batched"]
+                               and bool(digests["legacy"]))
+        if report["ops_per_unit"]["batched"]:
+            report["ops_per_unit_ratio"] = round(
+                report["ops_per_unit"]["legacy"]
+                / report["ops_per_unit"]["batched"], 2)
+        print(json.dumps(report, sort_keys=True))
+        if not report["identical"]:
+            print("elastic smoke: legacy and batched coordination shipped "
+                  "DIFFERENT bytes", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -413,6 +413,11 @@ def main():
                 "corpus_mb": args.mb,
                 "batch_size": args.batch_size,
                 "cpu_count": os.cpu_count(),
+                # Stamped next to every scaling number (ISSUE 15): a
+                # < 4-core bench host cannot exhibit worker scaling, so
+                # readers of the artifact must not treat flat ratios
+                # from such a host as a regression.
+                "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
                 "runs_per_config": args.runs,
                 "smoke": args.smoke,
                 "worker_scaling": scaling,

@@ -14,10 +14,26 @@ Phases, all through the REAL CLIs (fresh processes, the user surface):
 6. streaming ingestion on the same slice: ingest corpus A, then delta B
    incrementally (ingest_watch --once), recording delta-bytes-written vs
    full-rerun bytes and a mid-service follow-mode loader picking up the
-   new generation at an epoch boundary.
+   new generation at an epoch boundary;
+7. coordination cost: the same elastic preprocess twice on the slice
+   (2 hosts each) — legacy per-lease coordination (LDDL_TPU_COORD_LEGACY
+   + fixed --scatter-units) vs the default batched-keeper + adaptive
+   plan — recording lease filesystem ops per completed unit (the ratio
+   is the PR's acceptance number), gather-overlap seconds, and, from a
+   third leg with one host SIGKILLed, the reclamation latency between
+   the victim's last lease touch and the thief's steal (fleet event
+   walls);
+8. a full autoscale episode: ingest_watch --autoscale on a landing
+   burst — backlog spike over the SLO → scale_up (helper joins the
+   in-flight generation) → drain → scale_down — with the decisions read
+   back from the fleet event log and pipeline_status.
 
 Writes SCALE_RUN.json. Usage:
     python benchmarks/scale_run.py [--corpus-mb 1024] [--keep]
+    python benchmarks/scale_run.py --only coordination --corpus-mb 6
+The second form runs only phases 7-8 on a freshly generated slice and
+MERGES them into an existing SCALE_RUN.json, preserving the committed
+full-corpus numbers for the other phases.
 """
 
 import argparse
@@ -122,6 +138,303 @@ def count_spool_files(out_dir):
     return n
 
 
+def _spool_metrics(sink):
+    """Per-holder counter values merged across pids from the telemetry
+    spool snapshots. ``lease_ops_total`` (and the other coordination
+    counters) are deliberately NOT fleet rollup counters, so the
+    benchmark reads the raw registry snapshots the spools carry."""
+    tel = os.path.join(sink, ".telemetry")
+    out = {}
+    if not os.path.isdir(tel):
+        return out
+    for holder in sorted(os.listdir(tel)):
+        d = os.path.join(tel, holder)
+        if not os.path.isdir(d):
+            continue
+        merged = {}
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("snapshot-pid")
+                    and name.endswith(".json")):
+                continue
+            with open(os.path.join(d, name)) as f:
+                snap = json.load(f)
+            for metric, data in (snap.get("metrics") or {}).items():
+                if data.get("type") != "counter":
+                    continue
+                dst = merged.setdefault(metric, {})
+                for label, v in data.get("values", {}).items():
+                    dst[label] = dst.get(label, 0) + v
+        out[holder] = merged
+    return out
+
+
+def _counter_sum(spools, metric, label=None):
+    total = 0
+    for merged in spools.values():
+        vals = merged.get(metric, {})
+        total += vals.get(label, 0) if label else sum(vals.values())
+    return total
+
+
+def _fleet_events(sink):
+    from lddl_tpu.observability import fleet as fl
+    tel = os.path.join(sink, ".telemetry")
+    events = []
+    if not os.path.isdir(tel):
+        return events
+    for holder in sorted(os.listdir(tel)):
+        d = os.path.join(tel, holder)
+        if not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if name.startswith("events-pid") and name.endswith(".jsonl"):
+                recs, _ = fl.read_jsonl(os.path.join(d, name))
+                events.extend(recs)
+    return events
+
+
+def _steal_latencies(events):
+    """Wall seconds from the victim's last touch of a unit (claim,
+    renewal, or its own steal) to the thief's ``unit.stolen`` event for
+    that unit — the reclamation latency an operator actually waits
+    through (~ lease TTL + one claim-loop poll)."""
+    lats = []
+    for ev in events:
+        if ev.get("kind") != "unit.stolen":
+            continue
+        a = ev.get("args") or {}
+        unit, prev = a.get("unit"), a.get("prev_holder")
+        prior = [e.get("wall") for e in events
+                 if e.get("kind") in ("unit.claimed", "unit.renewed",
+                                      "unit.stolen")
+                 and (e.get("args") or {}).get("unit") == unit
+                 and (e.get("args") or {}).get("holder") == prev
+                 and e.get("wall") is not None
+                 and e.get("wall") < ev.get("wall", 0.0)]
+        if prior:
+            lats.append(ev["wall"] - max(prior))
+    return sorted(lats)
+
+
+def _parquet_digests(sink):
+    import hashlib
+    out = {}
+    for name in sorted(os.listdir(sink)):
+        if ".parquet" in name and ".tmp." not in name:
+            h = hashlib.sha256()
+            with open(os.path.join(sink, name), "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[name] = h.hexdigest()
+    return out
+
+
+def phase_coordination(tmp, vocab, coord_corpus, payload, n_hosts=3,
+                       lease_ttl=10.0):
+    """Phase 7: lease filesystem ops per completed unit, legacy vs
+    batched+adaptive coordination, plus steal latency under a host
+    kill. The legs run the coordination-BOUND shape from the issue
+    motivation — many blocks per unit, hosts > cores welcome — because
+    that is where per-unit lease traffic dominates: legacy pays one
+    fence read per block/bucket inside every unit body, while the
+    batched legs answer those checks from the deadline cache. Output
+    bytes must be identical across every leg (the coordination
+    protocol must never show up in the data)."""
+
+    def coord_cli(sink, holder, extra):
+        return [sys.executable, "-m",
+                "lddl_tpu.cli.preprocess_bert_pretrain",
+                "--wikipedia", coord_corpus, "--sink", sink,
+                "--vocab-file", vocab, "--masking", "--bin-size", "64",
+                "--num-blocks", "256", "--seed", "99",
+                "--local-workers", "1", "--elastic",
+                "--lease-ttl", str(lease_ttl),
+                "--elastic-host-id", holder, "--fleet-telemetry"] + extra
+
+    def run_hosts(sink, extra, env_extra, kill_host0=False):
+        env = dict(_env(), JAX_PLATFORMS="cpu",
+                   LDDL_TPU_FLEET_INTERVAL_S="1", **env_extra)
+        tag = os.path.basename(sink)
+        logs = [open(os.path.join(tmp, "{}_{}.log".format(tag, i)), "w")
+                for i in range(n_hosts)]
+        t0 = time.time()
+        if kill_host0:
+            env0 = dict(env)
+            env0["LDDL_TPU_FAULTS"] = "replace:kill:nth=1:path=_done/group-"
+            procs = [subprocess.Popen(coord_cli(sink, "c0", extra),
+                                      env=env0, stdout=logs[0],
+                                      stderr=subprocess.STDOUT)]
+            # Same head start as phase 5b: the victim must reach a
+            # gather publish before a sibling can drain the queue.
+            records = os.path.join(sink, "_done")
+            deadline = time.time() + 120
+            while time.time() < deadline and procs[0].poll() is None:
+                if os.path.isdir(records) and any(
+                        n.startswith("scatter-")
+                        for n in os.listdir(records)):
+                    break
+                time.sleep(0.2)
+            rest = range(1, n_hosts)
+        else:
+            procs = []
+            rest = range(n_hosts)
+        for i in rest:
+            procs.append(subprocess.Popen(
+                coord_cli(sink, "c{}".format(i), extra), env=env,
+                stdout=logs[i], stderr=subprocess.STDOUT))
+        rcs = [q.wait(timeout=1800) for q in procs]
+        wall = time.time() - t0
+        for f in logs:
+            f.close()
+        return rcs, wall
+
+    legs, digests = {}, {}
+    for mode, extra, env_extra in (
+            ("legacy", ["--scatter-units", "16"],
+             {"LDDL_TPU_COORD_LEGACY": "1"}),
+            ("batched_adaptive", [], {})):
+        sink = os.path.join(tmp, "coord_" + mode)
+        rcs, wall = run_hosts(sink, extra, env_extra)
+        assert rcs == [0] * n_hosts, \
+            "coordination {} leg failed: {}".format(mode, rcs)
+        spools = _spool_metrics(sink)
+        ops_by_op = {}
+        for merged in spools.values():
+            for label, v in merged.get("lease_ops_total", {}).items():
+                op = label.split("=", 1)[-1]
+                ops_by_op[op] = ops_by_op.get(op, 0) + v
+        ops = sum(ops_by_op.values())
+        units = _counter_sum(spools, "elastic_units_completed_total")
+        legs[mode] = {
+            "wall_s": round(wall, 1),
+            "units_completed": units,
+            "lease_fs_ops": ops,
+            "lease_fs_ops_by_op": ops_by_op,
+            "ops_per_unit": round(ops / max(units, 1), 2),
+            "renews": _counter_sum(spools, "lease_renews_total"),
+            "gather_overlap_s": round(_counter_sum(
+                spools, "gather_overlap_seconds_total"), 2),
+        }
+        digests[mode] = _parquet_digests(sink)
+        print("coordination {}: {}".format(mode, legs[mode]), flush=True)
+    assert digests["legacy"] == digests["batched_adaptive"], \
+        "coordination mode changed output bytes"
+
+    ratio = (legs["legacy"]["ops_per_unit"]
+             / max(legs["batched_adaptive"]["ops_per_unit"], 1e-9))
+    total_ratio = (legs["legacy"]["lease_fs_ops"]
+                   / max(legs["batched_adaptive"]["lease_fs_ops"], 1))
+    assert ratio >= 3.0, \
+        "batched coordination saved only {:.2f}x ops/unit".format(ratio)
+
+    # 7c: reclamation latency under a kill (default coordination).
+    steal_sink = os.path.join(tmp, "coord_steal")
+    rcs, steal_wall = run_hosts(steal_sink, [], {}, kill_host0=True)
+    assert rcs[0] == -signal.SIGKILL, \
+        "c0 was supposed to be SIGKILLed: {}".format(rcs)
+    assert rcs[1:] == [0] * (n_hosts - 1), "survivor failed: {}".format(rcs)
+    assert _parquet_digests(steal_sink) == digests["batched_adaptive"], \
+        "kill leg changed output bytes"
+    lats = _steal_latencies(_fleet_events(steal_sink))
+    assert lats, "no unit.stolen events in the kill leg"
+
+    payload["phases"]["coordination_cost"] = {
+        "hosts_per_leg": n_hosts,
+        "lease_ttl_s": lease_ttl,
+        "legacy": legs["legacy"],
+        "batched_adaptive": legs["batched_adaptive"],
+        "ops_per_unit_ratio": round(ratio, 2),
+        "total_ops_ratio": round(total_ratio, 2),
+        "bytes_identical_across_modes": True,
+        "steal_leg": {
+            "wall_s": round(steal_wall, 1),
+            "steals": len(lats),
+            "steal_latency_s_median": round(lats[len(lats) // 2], 2),
+            "steal_latency_s_max": round(lats[-1], 2),
+        },
+        "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+    }
+    print(payload["phases"]["coordination_cost"], flush=True)
+
+
+def phase_autoscale(tmp, vocab, coord_corpus, payload):
+    """Phase 8: one full autoscale episode through the real ingest_watch
+    CLI — a landing burst over the SLO scales a helper up into the
+    in-flight generation, the drain scales it back down, and both
+    decisions are read back from the fleet event log."""
+    landing = os.path.join(tmp, "autoscale_landing")
+    os.makedirs(os.path.join(landing, "source"), exist_ok=True)
+    src = os.path.join(coord_corpus, "source")
+    for name in sorted(os.listdir(src)):
+        shutil.copy(os.path.join(src, name),
+                    os.path.join(landing, "source", name))
+    sink = os.path.join(tmp, "autoscale_root")
+    # The burst must hold the backlog gauge above the SLO for longer
+    # than the first control round (interval/2), or the thermostat has
+    # nothing to observe: the whole landing set plus a high duplicate
+    # factor keeps generation 0 in flight for several control rounds,
+    # which is also what gives the scaled-up helper time to join it.
+    argv = [sys.executable, "-m", "lddl_tpu.cli.ingest_watch",
+            "--landing", landing, "--sink", sink, "--vocab-file", vocab,
+            "--masking", "--bin-size", "64", "--num-shards", "16",
+            "--seed", "99", "--local-workers", "1",
+            "--duplicate-factor", "16",
+            "--elastic", "--lease-ttl", "10", "--elastic-host-id", "svc",
+            "--fleet-telemetry", "--autoscale",
+            "--backlog-slo-docs", "64", "--max-helpers", "1",
+            "--drain-rounds", "1", "--interval", "2", "--max-rounds", "4"]
+    t0 = time.time()
+    with open(os.path.join(tmp, "autoscale.log"), "w") as lf:
+        rc = subprocess.run(argv, env=dict(_env(), JAX_PLATFORMS="cpu",
+                                           LDDL_TPU_FLEET_INTERVAL_S="1"),
+                            stdout=lf, stderr=subprocess.STDOUT,
+                            timeout=1800).returncode
+    wall = time.time() - t0
+    assert rc == 0, "autoscale watch leg failed rc={}".format(rc)
+
+    events = _fleet_events(sink)
+    episode = [dict(kind=ev["kind"], **(ev.get("args") or {}))
+               for ev in sorted(events, key=lambda e: e.get("wall", 0.0))
+               if ev.get("kind", "").startswith("autoscale.")]
+    kinds = sorted({e["kind"] for e in episode})
+    assert "autoscale.scale_up" in kinds, episode
+    assert "autoscale.scale_down" in kinds, episode
+
+    # The decisions must also be visible through the operator surface.
+    status = subprocess.run(
+        [sys.executable, "-m", "tools.pipeline_status", sink, "--json"],
+        env=dict(_env(), JAX_PLATFORMS="cpu"), capture_output=True,
+        text=True)
+    report = json.loads(status.stdout)
+    ev_counts = {}
+    for hostrep in report.get("hosts", {}).values():
+        for k, v in (hostrep.get("event_counts") or {}).items():
+            if k.startswith("autoscale."):
+                ev_counts[k] = ev_counts.get(k, 0) + v
+    assert ev_counts.get("autoscale.scale_up", 0) >= 1, ev_counts
+
+    spools = _spool_metrics(sink)
+    payload["phases"]["autoscale_episode"] = {
+        "wall_s": round(wall, 1),
+        "backlog_slo_docs": 64,
+        "max_helpers": 1,
+        "duplicate_factor": 16,
+        "episode": episode,
+        "decisions_total": {
+            "scale_up": _counter_sum(spools, "autoscale_decisions_total",
+                                     label="action=scale_up"),
+            "scale_down": _counter_sum(spools, "autoscale_decisions_total",
+                                       label="action=scale_down"),
+        },
+        "helper_joined_generation": any(
+            ev.get("kind") == "generation.joined" for ev in events),
+        "status_exit": status.returncode,
+        "status_event_counts": ev_counts,
+        "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+    }
+    print(payload["phases"]["autoscale_episode"], flush=True)
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--corpus-mb", type=float, default=1024.0)
@@ -129,14 +442,52 @@ def main():
     p.add_argument("--keep", action="store_true",
                    help="keep the work dir for inspection")
     p.add_argument("--workdir", default=None)
+    p.add_argument("--only", choices=("all", "coordination"),
+                   default="all",
+                   help="coordination: run only the coordination-cost + "
+                        "autoscale phases (7-8) on a freshly generated "
+                        "slice and merge them into an existing "
+                        "SCALE_RUN.json, preserving the committed "
+                        "full-corpus numbers for the other phases")
     args = p.parse_args()
 
     tmp = args.workdir or tempfile.mkdtemp(prefix="lddl_scale_",
                                            dir="/tmp")
     os.makedirs(tmp, exist_ok=True)
     payload = {"corpus_mb": args.corpus_mb, "num_blocks": args.num_blocks,
-               "host_cpu_count": os.cpu_count(), "phases": {}}
+               "host_cpu_count": os.cpu_count(),
+               "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+               "phases": {}}
     try:
+        if args.only == "coordination":
+            corpus = os.path.join(tmp, "corpus")
+            if not os.path.isdir(corpus):
+                bench.make_corpus(corpus, min(args.corpus_mb, 32.0),
+                                  shards=4, seed=0)
+            from lddl_tpu.preprocess import build_wordpiece_vocab
+            sample, sb = [], 0
+            with open(os.path.join(corpus, "source", "0.txt"),
+                      encoding="utf-8") as f:
+                for line in f:
+                    sample.append(line.split(None, 1)[1])
+                    sb += len(line)
+                    if sb > 1_500_000:
+                        break
+            vocab = build_wordpiece_vocab(
+                sample, os.path.join(tmp, "vocab.txt"), vocab_size=30522)
+            phase_coordination(tmp, vocab, corpus, payload)
+            phase_autoscale(tmp, vocab, corpus, payload)
+            doc_path = os.path.join(ROOT, "SCALE_RUN.json")
+            doc = payload
+            if os.path.exists(doc_path):
+                with open(doc_path) as f:
+                    doc = json.load(f)
+                doc.setdefault("phases", {}).update(payload["phases"])
+                doc["coordination_corpus_mb"] = min(args.corpus_mb, 32.0)
+            with open(doc_path, "w") as f:
+                json.dump(doc, f, indent=1)
+            print("merged coordination phases into SCALE_RUN.json")
+            return
         # --- phase 1: corpus + vocab --------------------------------------
         corpus = os.path.join(tmp, "corpus")
         t0 = time.time()
@@ -369,6 +720,7 @@ def main():
             "mb_per_s_1proc": round(mbps_1p, 2),
             "mb_per_s_nproc": round(mbps_np, 2),
             "scaling_ratio": round(mbps_np / max(mbps_1p, 1e-9), 2),
+            "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
         }
         # Fleet-telemetry acceptance, from the spool artifacts alone:
         # pipeline_status --json must see the SIGKILLed host as the one
@@ -534,6 +886,10 @@ def main():
         }
         print(payload["phases"]["incremental_ingest"], flush=True)
 
+        # --- phases 7-8: coordination cost + autoscale episode ------------
+        phase_coordination(tmp, vocab, sim_corpus, payload)
+        phase_autoscale(tmp, vocab, sim_corpus, payload)
+
         payload["note"] = (
             "all phases through the real CLIs on a single host; preprocess "
             "leg 1 is SIGKILLed once ~1/3 of gather units are ledgered and "
@@ -550,8 +906,19 @@ def main():
             "vs a from-scratch rerun over A∪B is the recorded ratio, "
             "prior shards must stay byte-identical, and the loader must "
             "pick up generation 1 at its next epoch boundary without "
-            "restart. Peak RSS = VmHWM summed over the worker tree, 1 s "
-            "polling.")
+            "restart. Phase 7 reruns the elastic preprocess twice with "
+            "two hosts each — legacy per-lease coordination vs the "
+            "batched keeper + adaptive plan — and records lease "
+            "filesystem ops per completed unit from lease_ops_total in "
+            "the spool snapshots (output bytes identical across modes), "
+            "plus steal latency from fleet event walls under a host "
+            "kill. Phase 8 records one full autoscale episode (backlog "
+            "spike -> scale_up -> helper joins -> drain -> scale_down) "
+            "through ingest_watch --autoscale, decisions read back from "
+            "the fleet event log. host_can_show_scaling flags whether "
+            "this host has enough cores (>= 4) for the concurrency "
+            "ratios to mean anything. Peak RSS = VmHWM summed over the "
+            "worker tree, 1 s polling.")
         with open(os.path.join(ROOT, "SCALE_RUN.json"), "w") as f:
             json.dump(payload, f, indent=1)
         print("wrote SCALE_RUN.json")

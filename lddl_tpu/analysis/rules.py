@@ -76,7 +76,15 @@ class WallClockRule(Rule):
     # Trace timestamps are the one legitimate wall-clock consumer;
     # benchmarks measure wall time by definition; lease deadlines are
     # wall-clock by design (lease-isolation guards what matters there).
-    allow = ("lddl_tpu/observability/*", "benchmarks/*",
+    # Observability files are allowlisted INDIVIDUALLY — autoscale.py is
+    # deliberately absent: scaling decisions must derive from the fleet
+    # aggregate, never from a clock read of its own.
+    allow = ("lddl_tpu/observability/registry.py",
+             "lddl_tpu/observability/tracing.py",
+             "lddl_tpu/observability/exporters.py",
+             "lddl_tpu/observability/fleet.py",
+             "lddl_tpu/observability/__init__.py",
+             "benchmarks/*",
              "lddl_tpu/resilience/leases.py")
 
     def run(self, ctx):

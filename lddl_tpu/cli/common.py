@@ -62,8 +62,12 @@ def attach_elastic_args(parser):
              "hostname-pid-nonce)")
     parser.add_argument(
         "--scatter-units", type=int, default=None,
-        help="elastic scatter work-unit count (block slices; default "
-             "min(blocks, max(16, blocks/16)))")
+        help="fixed elastic scatter work-unit count (block slices). "
+             "Default: ADAPTIVE — a few probe slices measure per-block "
+             "wall, then a journaled plan sizes the remaining units "
+             "toward a target wall of ~64x the measured lease overhead; "
+             "give an explicit count to pin the classic fixed stride "
+             "(the unit plan rides the resume fingerprint either way)")
 
 
 def attach_fleet_arg(parser):

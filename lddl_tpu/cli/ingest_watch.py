@@ -75,9 +75,67 @@ def attach_args(parser=None):
                              "touches prior shards, so only for "
                              "maintenance windows — not while a loader "
                              "streams the directory mid-epoch")
+    attach_bool_arg(parser, "autoscale", default=False,
+                    help_str="telemetry-driven autoscaling: a control "
+                             "thread reads the fleet aggregate every "
+                             "half interval and spawns/retires local "
+                             "helper processes (--join-pending mode) to "
+                             "hold --backlog-slo-docs; requires "
+                             "--elastic and --fleet-telemetry")
+    parser.add_argument("--backlog-slo-docs", type=int, default=512,
+                        help="autoscale SLO: spawn a helper while the "
+                             "fleet's ingest backlog gauge is at/above "
+                             "this many documents (or the service is "
+                             "wedged)")
+    parser.add_argument("--max-helpers", type=int, default=2,
+                        help="autoscale ceiling on concurrently running "
+                             "helper processes")
+    parser.add_argument("--drain-rounds", type=int, default=2,
+                        help="consecutive calm control rounds (no "
+                             "backlog, no pending work) before one "
+                             "helper is retired")
+    attach_bool_arg(parser, "join-pending", default=False,
+                    help_str="helper mode (what --autoscale spawns): "
+                             "join the in-flight generation's elastic "
+                             "preprocess from its frozen intake record, "
+                             "then poll for the next one; never scans "
+                             "the landing dir or commits the journal")
     attach_elastic_args(parser)
     attach_fleet_arg(parser)
     return parser
+
+
+def _helper_argv(args):
+    """The command line --autoscale spawns: this same CLI in
+    --join-pending mode, carrying every processor-config flag (the
+    helper recomputes the intake fingerprint and refuses on drift) but
+    none of the landing-scan knobs (frozen in the intake record)."""
+    import sys
+    argv = [sys.executable, "-m", "lddl_tpu.cli.ingest_watch",
+            "--landing", args.landing, "--sink", args.sink,
+            "--join-pending", "--elastic",
+            "--local-workers", str(args.local_workers),
+            "--lease-ttl", str(args.lease_ttl),
+            "--interval", str(args.interval),
+            "--num-shards", str(args.num_shards),
+            "--target-seq-length", str(args.target_seq_length),
+            "--short-seq-prob", str(args.short_seq_prob),
+            "--masked-lm-ratio", str(args.masked_lm_ratio),
+            "--duplicate-factor", str(args.duplicate_factor),
+            "--seed", str(args.seed),
+            "--schema-version", str(args.schema_version),
+            "--tokenizer-engine", args.tokenizer_engine]
+    if args.vocab_file:
+        argv += ["--vocab-file", args.vocab_file]
+    if args.tokenizer:
+        argv += ["--tokenizer", args.tokenizer]
+    if args.masking:
+        argv += ["--masking"]
+    if args.scatter_units is not None:
+        argv += ["--scatter-units", str(args.scatter_units)]
+    if args.fleet_telemetry:
+        argv += ["--fleet-telemetry"]
+    return argv
 
 
 def main(args=None):
@@ -100,7 +158,33 @@ def main(args=None):
         tokenizer_engine=args.tokenizer_engine,
         schema_version=args.schema_version,
     )
-    from ..ingest import ingest_once, watch
+    from ..ingest import ingest_once, join_pending_generation, watch
+    if args.join_pending:
+        # Helper mode: poll the journal for an in-flight generation and
+        # join its elastic claim loop. Retirement is a plain SIGTERM from
+        # the autoscaler — converted to a normal exit so the atexit hook
+        # closes the telemetry spool (pipeline_status then reads a clean
+        # shutdown, not a stalled host). A helper that dies mid-unit
+        # anyway just stops renewing its leases and the survivors steal.
+        import signal
+        import time
+
+        def _retired(signum, frame):
+            raise SystemExit(0)
+
+        signal.signal(signal.SIGTERM, _retired)
+        while True:
+            report = join_pending_generation(
+                args.sink, tokenizer, config=config,
+                num_workers=args.local_workers,
+                lease_ttl=args.lease_ttl,
+                holder_id=args.elastic_host_id,
+                scatter_units=args.scatter_units,
+                log=print)
+            print("ingest helper: {}".format(report))
+            if args.once:
+                return
+            time.sleep(max(1.0, args.interval / 3.0))
     kwargs = dict(
         config=config,
         num_shards=args.num_shards,
@@ -113,6 +197,61 @@ def main(args=None):
         pack_max_per_row=args.pack_max_per_row,
         **elastic_kwargs,
     )
+    if args.autoscale:
+        if args.once:
+            raise SystemExit("--autoscale requires the watch loop (it "
+                             "decides across rounds); drop --once")
+        if not args.elastic:
+            raise SystemExit("--autoscale needs --elastic: helpers join "
+                             "the preprocess through the lease claim loop")
+        if not args.fleet_telemetry:
+            raise SystemExit("--autoscale needs --fleet-telemetry: scale "
+                             "decisions read the fleet aggregate")
+        import subprocess
+        import threading
+        from ..observability.autoscale import Autoscaler
+
+        def spawn():
+            return subprocess.Popen(_helper_argv(args))
+
+        def retire(proc):
+            proc.terminate()
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+
+        scaler = Autoscaler(args.sink, spawn, retire,
+                            backlog_slo_docs=args.backlog_slo_docs,
+                            max_helpers=args.max_helpers,
+                            drain_rounds=args.drain_rounds,
+                            stall_ttl=args.lease_ttl, log=print)
+        stop = threading.Event()
+
+        def control_loop():
+            # Half the watch interval so a backlog spike seen at scan
+            # time scales up while the round's preprocess is still
+            # running — when a helper is actually useful.
+            while not stop.wait(max(1.0, args.interval / 2.0)):
+                try:
+                    scaler.step()
+                except Exception as e:  # noqa: BLE001 - keep controlling
+                    print("autoscale: control round failed ({}: {})".format(
+                        type(e).__name__, e))
+
+        thread = threading.Thread(target=control_loop, name="autoscale",
+                                  daemon=True)
+        thread.start()
+        try:
+            watch(args.sink, tokenizer, args.landing,
+                  interval_s=args.interval, max_rounds=args.max_rounds,
+                  log=print, **kwargs)
+        finally:
+            stop.set()
+            thread.join(timeout=5.0)
+            scaler.shutdown()
+        return
     if args.once:
         report = ingest_once(args.sink, tokenizer, landing=args.landing,
                              log=print, **kwargs)

@@ -2,8 +2,8 @@
 long-lived service over a growing corpus (see journal.py and
 incremental.py for the design)."""
 
-from .incremental import ingest_once, watch
+from .incremental import ingest_once, join_pending_generation, watch
 from .journal import Journal, diff_landing, doc_content_hash
 
 __all__ = ["Journal", "diff_landing", "doc_content_hash", "ingest_once",
-           "watch"]
+           "join_pending_generation", "watch"]

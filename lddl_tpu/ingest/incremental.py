@@ -434,6 +434,91 @@ def _carry_row_count(root, journal):
     return total
 
 
+def join_pending_generation(root, tokenizer, *, config=None, num_workers=1,
+                            lease_ttl=30.0, holder_id=None,
+                            scatter_units=None, comm=None, log=None):
+    """Join the in-flight generation's ELASTIC preprocess as a helper
+    host — the autoscaler's scale-up unit (observability/autoscale.py).
+
+    A helper never scans the landing dir, never balances, never commits
+    the journal: it replays the primary's FROZEN intake record (doc set
+    and knobs were fixed at intake time, so every joining host computes
+    the identical plan) and enters the same lease claim loop, stealing
+    scatter/gather units exactly like any elastic peer. It exits when
+    the preprocess phase is done (or there is nothing to join); the
+    primary's ingest round does the rest.
+
+    Returns a report dict: ``{"joined": False, "why": ...}`` when there
+    was nothing to do, else ``{"joined": True, "generation": N}``."""
+    from ..preprocess.bert import BertPretrainConfig
+    from ..preprocess.runner import BertBucketProcessor, run_bert_preprocess
+
+    log = log or (lambda msg: None)
+    obs.fleet.ensure_started()
+    config = config or BertPretrainConfig()
+    journal = journal_mod.Journal.load(root)
+    pending = journal.pending_work()
+    if pending is None:
+        return {"joined": False, "why": "no in-flight generation"}
+    generation = int(pending["generation"])
+    if not pending["hashes"]:
+        return {"joined": False, "why": "pending generation has no "
+                                        "documents (flush-only round)"}
+    # Same drift refusal as the primary, against the intake-frozen knobs:
+    # a helper with a different processor config would journal units
+    # whose bytes mean something else.
+    fingerprint = BertBucketProcessor(
+        tokenizer, config, int(pending["seed"]), root, pending["bin_size"],
+        "parquet",
+        pack_seq_length=pending.get("pack_seq_length"),
+        pack_max_per_row=pending.get("pack_max_per_row", 8)).fingerprint()
+    if pending.get("fingerprint") != fingerprint:
+        raise ValueError(
+            "helper configuration drift: in-flight generation {} was "
+            "started with fingerprint {} but this helper computes {}; "
+            "launch the helper with the primary's arguments".format(
+                generation, pending.get("fingerprint"), fingerprint))
+    wdir = journal_mod.work_dir(root, generation)
+    staging = os.path.join(wdir, "staging")
+    pre_dir = os.path.join(wdir, "pre")
+    if not os.path.isdir(staging):
+        return {"joined": False, "why": "staging corpus not on disk yet"}
+    if os.path.isdir(pre_dir) and get_all_parquets_under(pre_dir) \
+            and not os.path.isdir(os.path.join(pre_dir, "_done")):
+        # Shards present and the unit ledger already retired: the
+        # preprocess finished; the primary is balancing/committing and
+        # a late joiner would only redo finished work.
+        return {"joined": False, "why": "preprocess already finalized"}
+    log("ingest helper: joining generation {} ({} document(s))".format(
+        generation, len(pending["hashes"])))
+    with obs.span("ingest.join", generation=generation):
+        run_bert_preprocess(
+            {"ingest": staging},
+            pre_dir,
+            tokenizer,
+            config=config,
+            num_blocks=int(pending["num_blocks"]),
+            sample_ratio=1.0,
+            seed=int(pending["seed"]),
+            bin_size=pending["bin_size"],
+            global_shuffle=True,
+            comm=comm,
+            log=log,
+            num_workers=num_workers,
+            resume=os.path.isdir(pre_dir),
+            elastic=True,
+            lease_ttl=lease_ttl,
+            holder_id=holder_id,
+            scatter_units=scatter_units,
+            emit_manifest=False,
+            pack_seq_length=pending.get("pack_seq_length"),
+            pack_max_per_row=pending.get("pack_max_per_row", 8),
+        )
+    obs.fleet.record("generation.joined", generation=generation,
+                     holder=str(holder_id or ""))
+    return {"joined": True, "generation": generation}
+
+
 def watch(root, tokenizer, landing, interval_s=30.0, max_rounds=0,
           log=None, **kwargs):
     """The polling service loop: ``ingest_once`` forever (or

@@ -1068,10 +1068,13 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
         # The elastic unit plan (scatter slices, per-slice records, fenced
         # spool file names) is incompatible with the static layout and
         # with a different slice count — both are part of unit identity,
-        # so mixing them across a resume must refuse.
-        n_scatter_units = (min(nbuckets, max(16, nbuckets // 16))
-                          if scatter_units is None
-                          else max(1, min(int(scatter_units), nbuckets)))
+        # so mixing them across a resume must refuse. The default is the
+        # ADAPTIVE plan (probe slices + a journaled wall-informed split;
+        # steal._ensure_plan): its sentinel string deliberately mismatches
+        # any fixed integer count, so adaptive↔fixed resumes refuse too.
+        # An explicit --scatter-units keeps the classic fixed stride.
+        n_scatter_units = ("adaptive-v1" if scatter_units is None
+                           else max(1, min(int(scatter_units), nbuckets)))
         fingerprint["elastic"] = True
         fingerprint["scatter_units"] = n_scatter_units
     # An elastic host joining an in-progress run verifies against the
@@ -1104,6 +1107,7 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
 
     if elastic:
         spec["scatter_units"] = n_scatter_units
+        spec["adaptive_scatter"] = n_scatter_units == "adaptive-v1"
         spec["emit_manifest"] = bool(emit_manifest)
         from . import steal
         return steal.run_elastic_pipeline(

@@ -67,14 +67,18 @@ _BLOCK_PREFIX = "block-"
 _log = logging.getLogger("lddl_tpu.preprocess.steal")
 
 
-def _fence_for(out_dir, prefix, unit, epoch, holder):
-    """A zero-state fence closure for unit bodies (works across the pool
-    process boundary: everything needed to re-check the lease travels as
-    plain values). False once the unit's lease stops naming exactly this
-    (holder, epoch) attempt."""
+def _fence_for(out_dir, prefix, unit, epoch, holder, deadline=0.0):
+    """A fence closure for unit bodies (works across the pool process
+    boundary: everything needed to re-check the lease travels as plain
+    values and the closure is rebuilt inside the worker). Deadline-cached
+    via :func:`leases.fence_at` — while the wall clock is inside the last
+    deadline the fence read (seeded with the claim-time ``deadline`` when
+    the submitter passes it), the check costs no filesystem op; past it,
+    a real read refreshes from the keeper-renewed record. False once the
+    unit's lease stops naming exactly this (holder, epoch) attempt."""
     root = leases.lease_root(out_dir)
     key = "{}{}".format(prefix, unit)
-    return lambda: leases.verify_at(root, key, holder, epoch)
+    return leases.fence_at(root, key, holder, epoch, deadline=deadline)
 
 
 # ------------------------------------------------------------ unit records
@@ -96,17 +100,27 @@ def _read_scatter_record(out_dir, unit):
     return rec if isinstance(rec, dict) else None
 
 
-def _publish_scatter_record(out_dir, unit, lease):
+def _publish_scatter_record(out_dir, unit, lease, wall=None):
     """Journal a completed scatter slice. The record IS the epoch fence
     for spool bytes: it names the one (epoch, holder) attempt whose files
     the gather may read — so lease state flowing into this _done record
     is the design, not a leak (it never reaches shard bytes or
-    .manifest.json; the analyzer's lease-isolation rule guards those)."""
+    .manifest.json; the analyzer's lease-isolation rule guards those).
+    ``wall`` (a monotonic duration, seconds — never a wall-clock instant)
+    rides probe records so the adaptive plan can size the remaining units
+    from observed throughput; like epoch/holder it stays scheduling
+    state, retired with the ledger at finalize.
+
+    Returns the journaled record dict on success (the claim loop feeds it
+    to incremental consumers), False on a post-publish fence loss."""
     path = _scatter_record_path(out_dir, unit)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    payload = json.dumps({"epoch": lease.epoch, "holder": lease.holder},
-                         sort_keys=True)
-    # Fence record by design (see docstring): epoch+holder, wall-clock-free.
+    record = {"epoch": lease.epoch, "holder": lease.holder}
+    if wall is not None:
+        record["wall"] = round(float(wall), 6)
+    payload = json.dumps(record, sort_keys=True)
+    # Fence record by design (see docstring): epoch+holder+probe wall,
+    # never shard bytes.
     rio.atomic_write(path, payload)  # lddl: disable=lease-isolation,wall-clock-flow
     # Post-publish fence re-check: if the lease was stolen in the tiny
     # window between the pre-publish verify and this write, the thief may
@@ -116,14 +130,14 @@ def _publish_scatter_record(out_dir, unit, lease):
     # rather than pointing at deleted spool files.
     if not leases.verify(lease):
         cur = _read_scatter_record(out_dir, unit)
-        if cur == {"epoch": lease.epoch, "holder": lease.holder}:
+        if cur == record:
             try:
                 os.remove(path)
             except FileNotFoundError:
                 pass
         _prune_empty_scaffolding(out_dir)
         return False
-    return True
+    return record
 
 
 def _prune_empty_scaffolding(out_dir):
@@ -146,7 +160,9 @@ def _publish_gather_record(out_dir, unit, result, lease):
     between the claim loop's verify and this write, the record is
     withdrawn — a stalled zombie must not resurrect `_done/` inside an
     already-finalized output dir (and in the live-thief case a withdrawn
-    record merely makes the unit's owner republish identical bytes)."""
+    record merely makes the unit's owner republish identical bytes).
+    Returns the journaled record (= ``result``) on success so the
+    incremental gather can consume it without re-reading the ledger."""
     _runner._ledger_write(out_dir, unit, result)
     if not leases.verify(lease):
         try:
@@ -155,13 +171,192 @@ def _publish_gather_record(out_dir, unit, result, lease):
             pass
         _prune_empty_scaffolding(out_dir)
         return False
-    return True
+    return result
 
 
 def spool_name(unit, epoch, holder):
     """The exclusive spool file name of one scatter claim attempt (per
     coarse group). Epoch+holder make every attempt's files disjoint."""
     return "s{}.e{}.{}.txt".format(unit, epoch, holder)
+
+
+# --------------------------------------------------- adaptive unit sizing
+#
+# Fixed scatter units make small corpora coordination-bound: the lease
+# acquire/renew/fence cost per unit is flat regardless of how little work
+# the unit holds. Adaptive mode probes first — a few small leading slices
+# whose completion records carry their observed wall — then one
+# lease-guarded PLAN unit sizes the remaining blocks into contiguous
+# ranges targeting a wall of K × (measured lease round-trip). The plan is
+# journaled in ``_done/scatter-plan.json`` so every host (and every
+# resume) partitions identically; byte identity is untouched either way
+# because the gather sorts blocks by block id across the whole accept set
+# — unit boundaries only ever decide WHO spools a block, never where its
+# text lands.
+
+_PLAN_UNIT = "scatter-plan"
+_PLAN_TARGET_K = 64.0
+
+
+def _probe_layout(nblocks):
+    """The fixed leading probe slices: up to 4 contiguous ranges covering
+    at most ~1/8 of the blocks (1 block each on small plans). Deterministic
+    in nblocks alone, so every host agrees on probe identity before any
+    coordination happens."""
+    n_probe = min(nblocks, 4)
+    if n_probe <= 0:
+        return []
+    span = max(1, nblocks // (8 * n_probe))
+    return [("p{}".format(i), i * span, (i + 1) * span)
+            for i in range(n_probe)]
+
+
+def _scatter_unit_blocks(spec, unit, nblocks):
+    """The block indices one scatter unit owns. String units are probes
+    (contiguous leading ranges); int units are plan ranges when an
+    adaptive plan is loaded, else the classic ``unit, unit+S, ...``
+    stride of fixed mode."""
+    if isinstance(unit, str):
+        for key, s, e in _probe_layout(nblocks):
+            if key == unit:
+                return range(s, min(e, nblocks))
+        raise ValueError("unknown probe unit {!r}".format(unit))
+    plan = spec.get("scatter_plan")
+    if plan is not None:
+        s, e = plan["main"][int(unit)]
+        return range(s, min(e, nblocks))
+    return range(unit, nblocks, spec["scatter_units"])
+
+
+def _plan_record_path(out_dir):
+    return os.path.join(out_dir, _runner._LEDGER_DIR,
+                        "{}.json".format(_PLAN_UNIT))
+
+
+def _read_plan_record(out_dir):
+    rec, status = rio.read_json(_plan_record_path(out_dir))
+    if status == "torn":
+        _log.warning("torn scatter plan record; treating as absent")
+        return None
+    if isinstance(rec, dict) and isinstance(rec.get("main"), list):
+        return rec
+    return None
+
+
+def _read_plan_stable(out_dir, poll):
+    """Double-read the plan record (same clobber-then-withdraw window
+    argument as :func:`_stable_scatter_records`): a plan must never be
+    adopted from a fenced loser's transient record, because two hosts
+    running DIFFERENT partitions under the same unit indices would journal
+    ranges that don't line up."""
+    first = _read_plan_record(out_dir)
+    if first is None:
+        return None
+    time.sleep(min(poll, 0.05))
+    second = _read_plan_record(out_dir)
+    return second if second == first else None
+
+
+def _lease_overhead_s(lease):
+    """Measured lease round-trip (read + match), the unit-sizing yardstick.
+    Monotonic durations only — the plan never sees a wall-clock instant."""
+    t0 = time.monotonic()
+    for _ in range(3):
+        leases.verify_at(lease.root, lease.unit, lease.holder, lease.epoch)
+    return max((time.monotonic() - t0) / 3.0, 1e-6)
+
+
+def _compute_plan(out_dir, probes, nblocks, lease):
+    """Size the post-probe blocks into contiguous ranges whose predicted
+    wall is ~K× the measured lease overhead (clamped to [2s, 120s]), with
+    at least min(rest, 8) units so a small corpus still fans out across
+    hosts. Probe records missing a wall (fenced redo races) simply don't
+    vote; with no votes at all the split degrades to the fixed-mode
+    formula — the plan only ever shapes scheduling, never bytes."""
+    import math
+    walls, probed = [], 0
+    for key, s, e in probes:
+        rec = _read_scatter_record(out_dir, key)
+        w = rec.get("wall") if isinstance(rec, dict) else None
+        if isinstance(w, (int, float)) and w >= 0:
+            walls.append(float(w))
+            probed += max(1, min(e, nblocks) - s)
+    covered = min(probes[-1][2], nblocks) if probes else 0
+    rest = max(0, nblocks - covered)
+    plan = {"epoch": lease.epoch, "holder": lease.holder, "main": []}
+    if rest == 0:
+        return plan
+    if walls:
+        per_block = max(sum(walls) / max(probed, 1), 1e-6)
+        target = min(max(_PLAN_TARGET_K * _lease_overhead_s(lease), 2.0),
+                     120.0)
+        per_unit = max(1, int(target / per_block))
+        n_units = min(rest, max(min(rest, 8),
+                                int(math.ceil(rest / float(per_unit)))))
+        plan["per_block_s"] = round(per_block, 6)
+        plan["target_wall_s"] = round(target, 3)
+    else:
+        n_units = min(rest, max(8, rest // 16))
+    base, extra = divmod(rest, n_units)
+    start = covered
+    for i in range(n_units):
+        size = base + (1 if i < extra else 0)
+        plan["main"].append([start, start + size])
+        start += size
+    return plan
+
+
+def _ensure_plan(spec, probes, nblocks, holder, ttl, keeper, poll, log):
+    """Read-or-compute the adaptive scatter plan, exactly-once via the
+    ``scatter-plan`` lease (crash-tolerant like every other unit: a dead
+    planner's lease expires and a survivor recomputes from the journaled
+    probe walls). The plan is coordination metadata, not a work unit — it
+    does not count toward ``elastic_units_completed_total`` and emits no
+    ``unit.journaled`` event. Returns None when another host already
+    finalized the whole run."""
+    out_dir = spec["out_dir"]
+    root = leases.lease_root(out_dir)
+    ledger_dir = os.path.join(out_dir, _runner._LEDGER_DIR)
+    while True:
+        rec = _read_plan_stable(out_dir, poll)
+        if rec is not None:
+            return rec
+        if not os.path.isdir(ledger_dir):
+            return None  # finalized under us
+        lease = leases.try_acquire(root, _PLAN_UNIT, holder, ttl)
+        if lease is None:
+            time.sleep(poll)
+            continue
+        keeper.add(lease)
+        try:
+            rec = _read_plan_record(out_dir)  # post-acquire re-check
+            if rec is not None:
+                return rec
+            plan = _compute_plan(out_dir, probes, nblocks, lease)
+            if not leases.verify(lease):
+                continue
+            path = _plan_record_path(out_dir)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            payload = json.dumps(plan, sort_keys=True)
+            # Scheduling metadata fenced like a scatter record (see
+            # _publish_scatter_record): epoch/holder + monotonic durations.
+            rio.atomic_write(path, payload)  # lddl: disable=lease-isolation,wall-clock-flow
+            if not leases.verify(lease):
+                cur = _read_plan_record(out_dir)
+                if cur == plan:
+                    try:
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
+                _prune_empty_scaffolding(out_dir)
+                continue
+            log("elastic scatter: adaptive plan journaled ({} probe(s) + "
+                "{} main unit(s) over {} blocks)".format(
+                    len(probes), len(plan["main"]), nblocks))
+            return plan
+        finally:
+            keeper.remove(lease)
+            leases.release(lease)
 
 
 def _stable_scatter_records(out_dir, scatter_units, lease_root, ttl, poll):
@@ -212,22 +407,25 @@ def _stable_scatter_records(out_dir, scatter_units, lease_root, ttl, poll):
 #
 # Module-level so spawn pools can pickle them; serial mode calls them
 # directly via closures built in run_elastic_pipeline. All take
-# (unit, epoch, holder) so the claimed attempt's identity reaches the
-# spool file names.
+# (unit, epoch, holder, deadline) so the claimed attempt's identity
+# reaches the spool file names and the claim-time lease deadline seeds
+# the worker-side fence cache.
 
 
-def _scatter_slice(spec, unit, epoch, holder):
-    """Scatter all blocks of one slice (``unit, unit+S, ...``) into this
-    attempt's exclusive spool files, self-terminating between blocks if
-    the lease is stolen (appends after a steal would only be debris —
-    fenced out by name — but stopping early keeps the thief's sweep
-    meaningful and the host honest)."""
+def _scatter_slice(spec, unit, epoch, holder, deadline=0.0):
+    """Scatter all blocks of one slice (:func:`_scatter_unit_blocks` —
+    a fixed stride, a probe range, or a plan range) into this attempt's
+    exclusive spool files, self-terminating between blocks if the lease
+    is stolen (appends after a steal would only be debris — fenced out by
+    name — but stopping early keeps the thief's sweep meaningful and the
+    host honest)."""
     input_files = _runner.discover_source_files(spec["corpus_paths"])
     blocks = _runner.plan_blocks(input_files, spec["num_blocks"])
     name = spool_name(unit, epoch, holder)
-    fence = _fence_for(spec["out_dir"], _SCATTER_PREFIX, unit, epoch, holder)
+    fence = _fence_for(spec["out_dir"], _SCATTER_PREFIX, unit, epoch, holder,
+                       deadline=deadline)
     n = 0
-    for b in range(unit, len(blocks), spec["scatter_units"]):
+    for b in _scatter_unit_blocks(spec, unit, len(blocks)):
         _runner._check_fence(fence, unit)
         _runner._spool_one_block(blocks[b], spec["out_dir"], spec["seed"],
                                  spec["sample_ratio"], len(blocks),
@@ -236,24 +434,25 @@ def _scatter_slice(spec, unit, epoch, holder):
     return n
 
 
-def _pool_scatter_slice(unit, epoch, holder):
-    return _scatter_slice(_runner._POOL["spec"], unit, epoch, holder)
+def _pool_scatter_slice(unit, epoch, holder, deadline=0.0):
+    return _scatter_slice(_runner._POOL["spec"], unit, epoch, holder,
+                          deadline=deadline)
 
 
-def _pool_gather_group(unit, epoch, holder):
+def _pool_gather_group(unit, epoch, holder, deadline=0.0):
     spec = _runner._POOL["spec"]
     return _runner._run_group(
         spec, _runner._POOL["process_bucket"], unit,
         fence=_fence_for(spec["out_dir"], _GROUP_PREFIX, unit, epoch,
-                         holder))
+                         holder, deadline=deadline))
 
 
-def _pool_block_bucket(unit, epoch, holder):
+def _pool_block_bucket(unit, epoch, holder, deadline=0.0):
     spec = _runner._POOL["spec"]
     return _runner._run_block_bucket(
         spec, _runner._POOL["process_bucket"], unit,
         fence=_fence_for(spec["out_dir"], _BLOCK_PREFIX, unit, epoch,
-                         holder))
+                         holder, deadline=deadline))
 
 
 # ------------------------------------------------------------------ sweeps
@@ -324,7 +523,8 @@ def _rotated(units, holder):
 
 def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
                is_done, sweep, task, publish, executor_factory, max_inflight,
-               log, progress_interval=5.0, poll_s=None):
+               log, progress_interval=5.0, poll_s=None, ledger_name=None,
+               on_record=None, unit_walls=None):
     """Run every unit to completion across all participating hosts.
 
     Returns a stats dict. Raises RuntimeError (with the standard
@@ -336,16 +536,51 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
       ``{}`` record from a zero-sample unit is still done.
     - ``sweep(unit)`` — remove a prior attempt's partial outputs; called
       on EVERY claim before running (cheap no-op on first attempts).
-    - ``task(unit, epoch, holder)`` — the unit body; picklable when an
-      ``executor_factory`` is given (spawn pool), else any callable.
+    - ``task(unit, epoch, holder, deadline)`` — the unit body; picklable
+      when an ``executor_factory`` is given (spawn pool), else any
+      callable. ``deadline`` is the claim-time lease deadline, seeding
+      the body's deadline-cached fence (``leases.fence_at``).
     - ``publish(unit, result, lease)`` — journal completion; called only
       after the fence check passed. May return False to signal a
-      post-publish fence loss (the unit stays pending).
+      post-publish fence loss (the unit stays pending); any other return
+      value is treated as the journaled record.
+    - ``ledger_name(unit)`` — the unit's completion-record FILE NAME.
+      When given (and ``LDDL_TPU_COORD_LEGACY`` is unset), each scan pass
+      snapshots the ledger dir and the lease dir ONCE and skips per-unit
+      ``is_done``/lease reads that the snapshots already answer; every
+      decision that matters (post-acquire re-check, fence, publish) still
+      rides a real read, so a stale snapshot costs at most one extra pass.
+    - ``on_record(unit, record)`` — fired once per unit the first time
+      its completion record is observed (pre-done at entry, discovered
+      mid-scan, found post-acquire, or journaled by this host). Lets the
+      gather consume records incrementally instead of barriering.
+    - ``unit_walls`` — optional dict filled with each locally-completed
+      unit's monotonic task wall (seconds); probe publishes read it so
+      observed throughput reaches the adaptive plan.
     """
     from concurrent.futures.process import BrokenProcessPool
 
     lease_root = leases.lease_root(spec["out_dir"])
     ledger_dir = os.path.join(spec["out_dir"], _runner._LEDGER_DIR)
+    use_snapshot = ledger_name is not None and not leases.legacy_coordination()
+    held_cache = {} if use_snapshot else None
+    seen_records = set()
+
+    def record_seen(unit, rec):
+        if on_record is not None and rec is not None \
+                and unit not in seen_records:
+            seen_records.add(unit)
+            on_record(unit, rec)
+
+    def list_ledger():
+        """One listdir of ``_done`` per scan pass: a name absent from the
+        snapshot is definitely not journaled (records only ever appear;
+        they are withdrawn so rarely the next pass absorbs it), so the
+        per-unit is_done read is skipped for it."""
+        try:
+            return set(os.listdir(ledger_dir))
+        except (FileNotFoundError, NotADirectoryError):
+            return set()
 
     def run_finalized():
         """True once another host's finalize has retired the ledger. The
@@ -365,7 +600,17 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
     # empty {} record, and treating that as "not done" would make every
     # host redo empty units forever (the static resume path compares
     # `is None` for the same reason).
-    remaining = set(u for u in units if is_done(u) is None)
+    remaining = set()
+    entry_names = list_ledger() if use_snapshot else None
+    for u in units:
+        if entry_names is not None and ledger_name(u) not in entry_names:
+            remaining.add(u)
+            continue
+        rec = is_done(u)
+        if rec is None:
+            remaining.add(u)
+        else:
+            record_seen(u, rec)
     stats["already_done"] = len(units) - len(remaining)
     progress = _runner._Progress(log, phase, len(remaining),
                                  interval_s=progress_interval)
@@ -381,10 +626,13 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
                         else _InlineExecutor())
         return executor
 
+    start_times = {}  # future -> monotonic submit time (unit_walls only)
+
     def drop_inflight(fut):
         unit, lease = inflight.pop(fut)
+        started = start_times.pop(fut, None)
         keeper.remove(lease)
-        return unit, lease
+        return unit, lease, started
 
     def fence_reject(unit, lease, why):
         stats["fence_rejects"] += 1
@@ -397,7 +645,7 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
             "(fence)".format(phase, unit, why, lease.epoch))
 
     def handle_completed(fut):
-        unit, lease = drop_inflight(fut)
+        unit, lease, started = drop_inflight(fut)
         try:
             result = fut.result()
         except BrokenProcessPool:
@@ -412,7 +660,7 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
             fence_reject(unit, lease, "self-terminated (stolen)")
             return
         except Exception as e:  # noqa: BLE001 - isolate per unit
-            if lease.lost or not leases.verify(lease):
+            if not leases.still_held(lease):
                 # An error on a unit we no longer own is zombie noise,
                 # not a unit failure: a thief may have swept our spool
                 # files mid-append, or a finalizer may already be
@@ -430,14 +678,20 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
             log("{}: unit {} failed ({}); lease released for another "
                 "host".format(phase, unit, failed[unit]))
             return
-        if lease.lost or not leases.verify(lease):
+        if unit_walls is not None and started is not None:
+            unit_walls[unit] = time.monotonic() - started
+        if not leases.still_held(lease):
             # Stolen while we ran (we stalled past the deadline): the
-            # thief owns the unit now; discard our late result.
+            # thief owns the unit now; discard our late result. Inside
+            # the deadline this look is free (leases.still_held); the
+            # load-bearing fence is publish's post-publish re-verify.
             fence_reject(unit, lease, "was stolen while this host ran it")
             return
-        if publish(unit, result, lease) is False:
+        pub = publish(unit, result, lease)
+        if pub is False:
             fence_reject(unit, lease, "lost its lease during publish")
             return
+        record_seen(unit, pub if isinstance(pub, dict) else result)
         leases.release(lease)
         if lease.epoch > 0:
             stats["stolen"] += 1
@@ -470,7 +724,7 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
         log("{}: pool worker died; releasing {} in-flight lease(s) and "
             "rebuilding the pool".format(phase, len(inflight)))
         for fut in list(inflight):
-            _, lease = drop_inflight(fut)
+            _, lease, _ = drop_inflight(fut)
             leases.release(lease)
         if executor is not None:
             executor.shutdown(wait=False)
@@ -480,30 +734,49 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
         while remaining:
             claimed_any = False
             inflight_units = {u for u, _ in inflight.values()}
+            # Per-pass snapshots (batched coordination): one _done listdir
+            # answers "which units are journaled", one _leases scan feeds
+            # try_acquire's known_missing fast path, and held_cache skips
+            # re-reading leases whose observed deadline hasn't passed.
+            pass_names = list_ledger() if use_snapshot else None
+            pass_leases = (leases.scan_units(lease_root) if use_snapshot
+                           else None)
             for unit in order:
                 if len(inflight) >= max_inflight:
                     break
                 if unit not in remaining or unit in inflight_units \
                         or unit in failed:
                     continue
-                if is_done(unit) is not None:
+                if pass_names is not None \
+                        and ledger_name(unit) not in pass_names:
+                    rec = None
+                else:
+                    rec = is_done(unit)
+                if rec is not None:
+                    record_seen(unit, rec)
                     remaining.discard(unit)
                     progress.tick()
                     continue
                 if run_finalized():
                     remaining.clear()
                     break
+                key = "{}{}".format(unit_prefix, unit)
                 lease = leases.try_acquire(
-                    lease_root, "{}{}".format(unit_prefix, unit), holder,
-                    ttl)
+                    lease_root, key, holder, ttl,
+                    known_missing=(pass_leases is not None
+                                   and key not in pass_leases),
+                    held_cache=held_cache)
                 if lease is None:
                     continue  # validly held elsewhere (or race lost)
-                if is_done(unit) is not None:
+                rec = is_done(unit)
+                if rec is not None:
                     # Completion records publish BEFORE leases release, so
                     # re-checking after the acquire closes the race where
                     # our pre-claim is_done read predated the winner's
                     # publish: without this, we would sweep (and redo) a
-                    # unit whose outputs are already final.
+                    # unit whose outputs are already final. Always a REAL
+                    # read — never the snapshot.
+                    record_seen(unit, rec)
                     leases.release(lease)
                     remaining.discard(unit)
                     progress.tick()
@@ -525,8 +798,12 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
                 sweep(unit)
                 keeper.add(lease)
                 try:
+                    # Submit time taken BEFORE submit: the inline executor
+                    # runs the task inside submit(), so an after-the-fact
+                    # stamp would record a zero wall.
+                    t_submit = time.monotonic()
                     fut = ensure_executor().submit(task, unit, lease.epoch,
-                                                   holder)
+                                                   holder, lease.deadline)
                 except BrokenProcessPool:
                     # The pool broke while we were scanning (a worker died
                     # between drains): submit itself raises. Hand back the
@@ -535,6 +812,8 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
                     leases.release(lease)
                     nonlocal_executor_reset()
                     continue
+                if unit_walls is not None:
+                    start_times[fut] = t_submit
                 inflight[fut] = (unit, lease)
                 inflight_units.add(unit)
                 claimed_any = True
@@ -620,15 +899,21 @@ def _census_from_disk(out_dir):
     return written
 
 
-def _merge_census(out_dir, gather_units):
+def _merge_census(out_dir, gather_units, census=None, consumed=()):
     """Union of every gather unit's ledger record — the global census (in
     elastic mode hosts do not own disjoint buckets, so every host returns
-    the merged totals). The claim loop observed every record before this
-    runs; a record missing NOW means another host's finalize is already
-    deleting the ledger, and the on-disk output files (all final at this
-    point) are the authoritative fallback."""
-    written = {}
+    the merged totals). Units already consumed incrementally (the
+    overlapped gather's ``on_record`` hook) are not re-read: a gather
+    unit's record content is a pure function of the plan, so the copy
+    consumed in flight equals what a barrier read would see even if the
+    record was withdrawn and republished in between. A record missing NOW
+    means another host's finalize is already deleting the ledger, and the
+    on-disk output files (all final at this point) are the authoritative
+    fallback."""
+    written = dict(census or {})
     for g in gather_units:
+        if g in consumed:
+            continue
         rec = _runner._ledger_read(out_dir, g)
         if rec is None:
             _log.info("ledger record for unit %s already cleaned up by "
@@ -729,10 +1014,33 @@ def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
 
     try:
         if spec["global_shuffle"]:
-            n_slices = spec["scatter_units"]
-            scatter_units = list(range(n_slices))
-            factory = _pool_factory_for(process_bucket, spec, workers,
-                                        n_slices)
+            adaptive = bool(spec.get("adaptive_scatter"))
+            nblocks = len(_runner.plan_blocks(
+                _runner.discover_source_files(spec["corpus_paths"]),
+                spec["num_blocks"]))
+            scatter_walls = {}
+
+            def scatter_loop(unit_list):
+                factory = _pool_factory_for(process_bucket, spec, workers,
+                                            len(unit_list))
+                return claim_loop(
+                    spec, "elastic scatter", _SCATTER_PREFIX, unit_list,
+                    holder=holder, ttl=ttl, keeper=keeper,
+                    is_done=lambda u: _read_scatter_record(out_dir, u),
+                    ledger_name=lambda u: "scatter-{}.json".format(u),
+                    sweep=lambda u: _sweep_scatter(spec, u),
+                    task=(_pool_scatter_slice if factory else
+                          (lambda u, e, h, d=0.0: _scatter_slice(
+                              spec, u, e, h, deadline=d))),
+                    publish=lambda u, res, lease: _publish_scatter_record(
+                        out_dir, u, lease,
+                        wall=scatter_walls.get(u) if adaptive else None),
+                    unit_walls=scatter_walls,
+                    executor_factory=factory,
+                    max_inflight=max(1, workers),
+                    log=log, progress_interval=progress_interval,
+                    poll_s=poll_s)
+
             # The accept set: exactly the winning attempt's spool files
             # per slice, read back STABLY after every slice is journaled
             # — identical on every host regardless of who ran what. A
@@ -740,23 +1048,34 @@ def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
             # died) re-enters the claim loop, which skips done units and
             # redoes only the un-journaled one.
             while True:
-                with obs.span("preprocess.scatter", elastic=True,
-                              holder=holder):
-                    add_stats(claim_loop(
-                        spec, "elastic scatter", _SCATTER_PREFIX,
-                        scatter_units,
-                        holder=holder, ttl=ttl, keeper=keeper,
-                        is_done=lambda u: _read_scatter_record(out_dir, u),
-                        sweep=lambda u: _sweep_scatter(spec, u),
-                        task=(_pool_scatter_slice if factory else
-                              (lambda u, e, h: _scatter_slice(
-                                  spec, u, e, h))),
-                        publish=lambda u, res, lease:
-                            _publish_scatter_record(out_dir, u, lease),
-                        executor_factory=factory,
-                        max_inflight=max(1, workers),
-                        log=log, progress_interval=progress_interval,
-                        poll_s=poll_s))
+                if adaptive:
+                    # Probes first (fixed identity), then the lease-guarded
+                    # plan sizes the remaining blocks; the main loop's pool
+                    # factory is built AFTER the plan lands in spec, so a
+                    # spawn pool's spec snapshot carries it.
+                    probes = _probe_layout(nblocks)
+                    with obs.span("preprocess.scatter", elastic=True,
+                                  holder=holder, adaptive=True):
+                        add_stats(scatter_loop([k for k, _, _ in probes]))
+                        # The plan record carries epoch/holder ON PURPOSE
+                        # (fencing audit trail, like every _done record);
+                        # it is a journaled-once shared fact, not shard
+                        # content — byte identity is pinned by tests.
+                        plan = _ensure_plan(spec, probes, nblocks, holder,  # lddl: disable=lease-isolation,wall-clock-flow
+                                            ttl, keeper, poll, log)
+                        if plan is None:
+                            status, recs = "finalized", None
+                            break
+                        spec["scatter_plan"] = {"main": plan["main"]}
+                        add_stats(scatter_loop(
+                            list(range(len(plan["main"])))))
+                    scatter_units = ([k for k, _, _ in probes]
+                                     + list(range(len(plan["main"]))))
+                else:
+                    scatter_units = list(range(spec["scatter_units"]))
+                    with obs.span("preprocess.scatter", elastic=True,
+                                  holder=holder):
+                        add_stats(scatter_loop(scatter_units))
                 status, recs = _stable_scatter_records(
                     out_dir, scatter_units, leases.lease_root(out_dir),
                     ttl, poll)
@@ -775,20 +1094,37 @@ def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
             gather_prefix, gather_phase = _GROUP_PREFIX, "elastic gather"
             gather_task_pool, gather_sweep = _pool_gather_group, _sweep_gather
 
-            def serial_gather(u, e, h):
+            def serial_gather(u, e, h, d=0.0):
                 return _runner._run_group(
                     spec, process_bucket, u,
-                    fence=_fence_for(out_dir, _GROUP_PREFIX, u, e, h))
+                    fence=_fence_for(out_dir, _GROUP_PREFIX, u, e, h,
+                                     deadline=d))
         else:
             gather_units = list(range(spec["nbuckets"]))
             gather_prefix, gather_phase = _BLOCK_PREFIX, "elastic process"
             gather_task_pool, gather_sweep = _pool_block_bucket, _sweep_block
 
-            def serial_gather(u, e, h):
+            def serial_gather(u, e, h, d=0.0):
                 return _runner._run_block_bucket(
                     spec, process_bucket, u,
-                    fence=_fence_for(out_dir, _BLOCK_PREFIX, u, e, h))
+                    fence=_fence_for(out_dir, _BLOCK_PREFIX, u, e, h,
+                                     deadline=d))
 
+        # Overlapped gather: consume each unit's census record the moment
+        # it is observed (journaled by us, or discovered on disk from
+        # another host) instead of re-reading every record at a barrier
+        # after the loop. Record content is plan-deterministic, so the
+        # in-flight copy is what a barrier read would return; byte
+        # identity is untouched. Disabled (empty hook) under
+        # LDDL_TPU_COORD_LEGACY=1.
+        census, consumed_at = {}, {}
+
+        def on_gather_record(u, rec):
+            consumed_at[u] = time.monotonic()
+            if isinstance(rec, dict):
+                census.update(rec)
+
+        legacy = leases.legacy_coordination()
         factory = _pool_factory_for(process_bucket, spec, workers,
                                     len(gather_units))
         with obs.span("preprocess.gather", elastic=True, holder=holder):
@@ -796,6 +1132,8 @@ def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
                 spec, gather_phase, gather_prefix, gather_units,
                 holder=holder, ttl=ttl, keeper=keeper,
                 is_done=lambda u: _runner._ledger_read(out_dir, u),
+                ledger_name=lambda u: "group-{}.json".format(u),
+                on_record=None if legacy else on_gather_record,
                 sweep=lambda u: gather_sweep(spec, u),
                 task=gather_task_pool if factory else serial_gather,
                 publish=lambda u, res, lease: _publish_gather_record(
@@ -804,13 +1142,27 @@ def run_elastic_pipeline(spec, process_bucket, log, *, holder_id, lease_ttl,
                 log=log, progress_interval=progress_interval,
                 poll_s=poll_s))
 
-        # Merge the global census BEFORE finalize can delete the ledger.
-        written = _merge_census(out_dir, gather_units)
+        # Merge the global census BEFORE finalize can delete the ledger;
+        # only units the overlapped consume missed are read here. The
+        # saved wall = how long each consumed record would have sat
+        # waiting for this barrier.
+        barrier_t = time.monotonic()
+        # Gather census records are pure instance counts; the lease taint
+        # the flow engine sees rides claim_loop's shared record plumbing
+        # (scatter records DO carry epoch/holder), never gather content.
+        written = _merge_census(out_dir, gather_units, census=census,  # lddl: disable=lease-isolation,wall-clock-flow
+                                consumed=set(consumed_at))
+        if consumed_at:
+            obs.inc("gather_overlap_seconds_total",
+                    sum(barrier_t - t for t in consumed_at.values()))
         log("elastic summary: holder={} units={} steals={} "
             "fence_rejects={}".format(holder, totals["completed"],
                                       totals["stolen"],
                                       totals["fence_rejects"]))
-        _finalize(spec, holder, ttl, keeper, log, poll)
+        # spec carries the adopted plan's block ranges (journaled-once
+        # shared fact); the manifest lists shards whose bytes are
+        # partition-independent — identity pinned across fixed/adaptive.
+        _finalize(spec, holder, ttl, keeper, log, poll)  # lddl: disable=lease-isolation,wall-clock-flow
     finally:
         keeper.stop()
 

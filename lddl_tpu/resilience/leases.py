@@ -61,6 +61,24 @@ _log = logging.getLogger("lddl_tpu.resilience.leases")
 _SAFE_RE = re.compile(r"[^A-Za-z0-9_.-]+")
 
 
+def legacy_coordination():
+    """True when ``LDDL_TPU_COORD_LEGACY=1`` pins the pre-batched
+    coordination paths (per-lease keeper renewals with read-back,
+    unsnapshotted claim-loop scans, barrier gather). Kept so benchmarks
+    can measure the batched protocol against its ancestor honestly and
+    tests can compare the two for byte identity."""
+    return os.environ.get("LDDL_TPU_COORD_LEGACY", "") == "1"
+
+
+def _op(kind):
+    """Count one lease-file filesystem operation. ``lease_ops_total`` is
+    the coordination-cost headline (ISSUE 15): every lease read, publish,
+    exclusive create, unlink, and directory scan increments it exactly
+    once, on the legacy and batched paths alike, so the ratio between the
+    two is an apples-to-apples count of FS round trips."""
+    obs_inc("lease_ops_total", op=kind)
+
+
 class LeaseLost(RuntimeError):
     """The lease was stolen (epoch bumped / holder replaced) out from
     under its holder; the unit in flight must be self-terminated."""
@@ -125,6 +143,7 @@ def read_lease(root, unit):
     expired epoch-0 lease with a warning, so a flaky byte never wedges the
     scheduler; the fence still protects the ledger."""
     path = lease_path(root, unit)
+    _op("read")
     rec, status = rio.read_json(path)
     if status == "missing":
         return None
@@ -173,6 +192,7 @@ def _try_create(path, rec, holder):
     fails loudly on EEXIST even on NFS; filesystems that refuse hard links
     fall back to O_CREAT|O_EXCL (fine everywhere the fallback runs: a FUSE
     mount without link support is also not an NFSv2 mount)."""
+    _op("create")
     tmp = _write_tmp(path, rec, holder)
     try:
         try:
@@ -202,6 +222,7 @@ def _try_create(path, rec, holder):
 def _publish(path, rec, holder):
     """Replace the lease file with a fully-written record (tmp + fsync +
     ``os.replace`` + dir fsync via resilience.io)."""
+    _op("publish")
     tmp = _write_tmp(path, rec, holder)
     try:
         rio.atomic_publish(tmp, path)
@@ -209,7 +230,23 @@ def _publish(path, rec, holder):
         _cleanup_tmp(tmp)
 
 
-def try_acquire(root, unit, holder, ttl_s, now_fn=time.time):
+def scan_units(root):
+    """One directory scan of the lease root: the set of unit keys that
+    currently have a lease file (tmp debris excluded), or None when the
+    root itself is gone (finalized/absent). A single scan stands in for
+    per-unit existence reads — the amortization both the batched keeper
+    pass and the claim loop's per-pass snapshot ride."""
+    _op("scan")
+    try:
+        names = sorted(os.listdir(root))
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    return {n[:-len(".json")] for n in names
+            if n.endswith(".json") and ".tmp." not in n}
+
+
+def try_acquire(root, unit, holder, ttl_s, now_fn=time.time,
+                known_missing=False, held_cache=None):
     """Claim ``unit``: returns a :class:`Lease` on success, None when the
     unit is validly held by someone else (or a race was lost).
 
@@ -217,27 +254,75 @@ def try_acquire(root, unit, holder, ttl_s, now_fn=time.time):
     torn) lease is **stolen**: the epoch is bumped and the record
     replaced, then read back — only the claimant whose bytes survived the
     replace race proceeds. The read-back does not make concurrent steals
-    perfectly exclusive; the publish-time fence does (module docstring)."""
+    perfectly exclusive; the publish-time fence does (module docstring).
+
+    Two amortization knobs (both safe to omit):
+
+    - ``known_missing=True`` — the caller's per-pass :func:`scan_units`
+      snapshot showed no lease file, so skip the initial read and go
+      straight to the exclusive create; a racer who created one since the
+      scan just fails the create and falls back to the read path.
+    - ``held_cache`` — a ``{unit: deadline}`` dict the caller threads
+      through its passes. A valid-held conflict records the observed
+      deadline; later calls for the same unit return None without any
+      filesystem read until that deadline has passed. The wall-clock
+      comparison stays inside this module (the one allowlisted clock
+      consumer); a cached skip is not an acquire attempt, so it counts
+      neither ops nor conflicts.
+    """
+    now = now_fn()
+    if held_cache is not None:
+        cached = held_cache.get(unit)
+        if cached is not None:
+            if cached > now:
+                return None
+            held_cache.pop(unit, None)
     os.makedirs(root, exist_ok=True)
     path = lease_path(root, unit)
     faults.fault_point("lease-acquire", path)
-    cur = read_lease(root, unit)
-    now = now_fn()
+    cur = None if known_missing else read_lease(root, unit)
     if cur is None:
         rec = _record(unit, holder, 0, now + ttl_s)
         if _try_create(path, rec, holder):
+            if not legacy_coordination():
+                # The exclusive create succeeded, so the bytes on disk are
+                # ours and nobody may validly steal them before the fresh
+                # deadline: the legacy read-back can only confirm that.
+                # The one race it narrowed — a thief who read a stale
+                # EXPIRED record, lost it to a release-unlink, and then
+                # replaces our newborn file — leaves two hosts transiently
+                # believing they won, which the module docstring already
+                # declares fine by design: the publish-time fence picks
+                # one winner, the loser's work is the only cost.
+                obs_inc("lease_acquires_total")
+                fleet.record("unit.claimed", unit=str(unit), epoch=0,
+                             holder=holder)
+                return Lease(root, unit, holder, 0, rec["deadline"])
             got = read_lease(root, unit)
             if _matches(got, holder, 0):
                 obs_inc("lease_acquires_total")
                 fleet.record("unit.claimed", unit=str(unit), epoch=0,
                              holder=holder)
                 return Lease(root, unit, holder, 0, rec["deadline"])
-        obs_inc("lease_acquire_conflicts_total")
-        return None
+            obs_inc("lease_acquire_conflicts_total")
+            return None
+        if not known_missing:
+            obs_inc("lease_acquire_conflicts_total")
+            return None
+        # The snapshot was stale (someone created the lease since the
+        # scan): re-enter through the normal read path.
+        cur = read_lease(root, unit)
+        if cur is None:
+            # Created then already released/swept between our two looks;
+            # treat as a lost race rather than spinning here.
+            obs_inc("lease_acquire_conflicts_total")
+            return None
     if float(cur.get("deadline", 0.0)) > now and not cur.get("torn"):
         # Validly held (possibly by a past incarnation of ourselves — a
         # claim loop never double-claims, so "held by my id" is equally
         # a conflict here).
+        if held_cache is not None:
+            held_cache[unit] = float(cur.get("deadline", 0.0))
         obs_inc("lease_acquire_conflicts_total")
         return None
     new_epoch = int(cur.get("epoch", 0)) + 1
@@ -283,6 +368,30 @@ def renew(lease, ttl_s, now_fn=time.time):
     return lease
 
 
+def renew_fast(lease, ttl_s, now_fn=time.time):
+    """Batched-keeper renewal: read → fence-match → publish, with NO
+    read-back. The read-back in :func:`renew` only narrows (never closes)
+    the replace race — the publish-time fence plus the next keeper pass's
+    read give the same guarantee one FS round trip cheaper, which is the
+    point of the batched pass. Counters and fleet events are identical to
+    :func:`renew`; the ``lease-renew`` fault site still fires first, so
+    the chaos suite's forced-stall steal scenario is unchanged."""
+    path = lease.path
+    faults.fault_point("lease-renew", path)
+    cur = read_lease(lease.root, lease.unit)
+    if not _matches(cur, lease.holder, lease.epoch):
+        lease.lost = True
+        raise LeaseLost("lease for unit {} was stolen (now {})".format(
+            lease.unit, cur))
+    rec = _record(lease.unit, lease.holder, lease.epoch, now_fn() + ttl_s)
+    _publish(path, rec, lease.holder)
+    lease.deadline = rec["deadline"]
+    obs_inc("lease_renews_total")
+    fleet.record("unit.renewed", unit=str(lease.unit), epoch=lease.epoch,
+                 holder=lease.holder)
+    return lease
+
+
 def verify(lease):
     """Fence check: True iff the on-disk lease still names this holder AND
     epoch. Run immediately before journaling a completed unit; False means
@@ -313,13 +422,84 @@ def verify_at(root, unit, holder, epoch):
     return _matches(read_lease(root, unit), holder, epoch)
 
 
-def release(lease):
-    """Drop a completed unit's lease (verified unlink). Best-effort: the
-    unit's ledger record is the durable completion signal — claim loops
-    check the ledger before the lease — so a leftover lease file is inert
-    and gets swept with the rest of ``_leases/`` at finalize."""
+def fence_at(root, unit, holder, epoch, deadline=0.0, now_fn=time.time):
+    """A deadline-cached fence closure over :func:`verify_at`, for unit
+    bodies that re-check their lease between sub-steps.
+
+    The protocol forbids stealing an unexpired lease (:func:`try_acquire`
+    refuses a record whose deadline is ahead), so while the wall clock is
+    strictly inside the last deadline this fence READ — seeded with the
+    claim-time ``deadline`` when the caller knows it — the on-disk record
+    provably still names ``(holder, epoch)`` and the closure answers True
+    with no filesystem op. At/past the cached deadline it re-reads,
+    refreshing the cache from the record the keeper's renewals have been
+    pushing out; a mismatch is final (epochs never revert). A stall long
+    enough to let a thief in necessarily carries the wall past the cached
+    deadline too, so the first post-stall call is a real read and the
+    fence trips exactly where an every-call read would have tripped it.
+    Legacy coordination pins every call to a real read. The wall-clock
+    comparison stays in this module (the allowlisted clock consumer)."""
+    state = {"deadline": float(deadline), "ok": True}
+    legacy = legacy_coordination()
+
+    def check():
+        if not state["ok"]:
+            return False
+        if not legacy and now_fn() < state["deadline"]:
+            return True
+        rec = read_lease(root, unit)
+        if not _matches(rec, holder, epoch):
+            state["ok"] = False
+            return False
+        state["deadline"] = float(rec.get("deadline", 0.0))
+        return True
+
+    return check
+
+
+def still_held(lease, now_fn=time.time):
+    """Deadline-aware pre-publish look at a held lease: False when the
+    keeper already flagged it lost; True WITHOUT a filesystem read while
+    the wall clock is strictly inside the last deadline this process
+    acquired/renewed to (an unexpired lease cannot be validly stolen, so
+    a read could only confirm ownership); a real :func:`verify` read past
+    the deadline or under legacy coordination. Advisory only — the
+    correctness fence is the post-publish re-verify inside the unit
+    record publishers, which always reads."""
+    if lease.lost:
+        return False
+    if not legacy_coordination() and now_fn() < lease.deadline:
+        return True
+    return verify(lease)
+
+
+def release(lease, now_fn=time.time):
+    """Drop a completed unit's lease (verified unlink; inside the deadline
+    the verify read is skipped). Best-effort: the unit's ledger record is
+    the durable completion signal — claim loops check the ledger before
+    the lease — so a leftover lease file is inert and gets swept with the
+    rest of ``_leases/`` at finalize."""
     faults.fault_point("lease-release", lease.path)
+    if lease.lost:
+        return
+    if not legacy_coordination() and now_fn() < lease.deadline:
+        # An unexpired lease cannot have been validly stolen, so the
+        # pre-unlink verify read could only confirm the record is ours.
+        # Should a clock-skewed early thief have replaced it anyway, the
+        # unlink drops the thief's lease: for a journaled unit (ledger
+        # publishes BEFORE release) the thief's own post-acquire ledger
+        # re-check retires the duplicate attempt; otherwise the thief
+        # merely loses the efficiency lever and the publish-time fence
+        # picks one winner, as for any concurrent-claim race.
+        _op("unlink")
+        try:
+            os.unlink(lease.path)
+        except FileNotFoundError:
+            pass
+        obs_inc("lease_releases_total")
+        return
     if verify(lease):
+        _op("unlink")
         try:
             os.unlink(lease.path)
         except FileNotFoundError:
@@ -362,24 +542,62 @@ class LeaseKeeper(object):
 
     def _run(self):
         period = max(self.ttl_s / 3.0, 0.05)
+        legacy = legacy_coordination()
         while not self._stop.wait(period):
             with self._lock:
                 held = list(self._leases)
+            if legacy:
+                for lease in held:
+                    if lease.lost:
+                        continue
+                    self._renew_one(lease, renew)
+                continue
+            # Batched pass: one directory scan per lease root answers
+            # "does my file still exist" for every held lease at once; a
+            # lease missing from the scan was stolen-then-released (or the
+            # run finalized) — the same on-disk states a legacy renew()
+            # would discover one read at a time. Survivors renew via
+            # renew_fast (read + publish, no read-back): 1 + 2n FS ops per
+            # pass instead of 3n.
+            by_root = {}
             for lease in held:
-                if lease.lost:
-                    continue
+                if not lease.lost:
+                    by_root.setdefault(lease.root, []).append(lease)
+            for root, group in by_root.items():
                 try:
-                    renew(lease, self.ttl_s)
-                except LeaseLost:
-                    obs_event("lease.lost", unit=str(lease.unit),
-                              epoch=lease.epoch)
-                    fleet.record("unit.lost", unit=str(lease.unit),
-                                 epoch=lease.epoch, holder=lease.holder)
-                    _log.warning("lease for unit %s stolen at epoch %s; "
-                                 "in-flight result will be fenced off",
-                                 lease.unit, lease.epoch)
-                except Exception as e:  # noqa: BLE001 - see class docstring
-                    lease.lost = True
-                    _log.warning("lease renewal for unit %s failed (%s: "
-                                 "%s); treating as lost", lease.unit,
+                    present = scan_units(root)
+                except Exception as e:  # noqa: BLE001 - class docstring
+                    _log.warning("lease scan of %s failed (%s: %s); "
+                                 "renewing individually", root,
                                  type(e).__name__, e)
+                    present = None
+                    scan_failed = True
+                else:
+                    scan_failed = False
+                for lease in group:
+                    if (not scan_failed and (
+                            present is None
+                            or str(lease.unit) not in present)):
+                        lease.lost = True
+                        self._mark_lost(lease)
+                        continue
+                    self._renew_one(lease, renew_fast)
+
+    def _renew_one(self, lease, renew_fn):
+        try:
+            renew_fn(lease, self.ttl_s)
+        except LeaseLost:
+            self._mark_lost(lease)
+        except Exception as e:  # noqa: BLE001 - see class docstring
+            lease.lost = True
+            _log.warning("lease renewal for unit %s failed (%s: %s); "
+                         "treating as lost", lease.unit,
+                         type(e).__name__, e)
+
+    @staticmethod
+    def _mark_lost(lease):
+        obs_event("lease.lost", unit=str(lease.unit), epoch=lease.epoch)
+        fleet.record("unit.lost", unit=str(lease.unit), epoch=lease.epoch,
+                     holder=lease.holder)
+        _log.warning("lease for unit %s stolen at epoch %s; in-flight "
+                     "result will be fenced off", lease.unit, lease.epoch)

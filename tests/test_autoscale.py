@@ -1,0 +1,188 @@
+"""The telemetry-driven autoscaler (lddl_tpu/observability/autoscale.py):
+decision policy over synthetic aggregate reports, spawn/retire plumbing,
+journaling into the fleet event log, and the clock-free guarantee the
+analyzer enforces (autoscale.py is deliberately NOT wall-clock
+allowlisted)."""
+
+import os
+
+import pytest
+
+from lddl_tpu import observability as obs
+from lddl_tpu.observability import fleet, tracing
+from lddl_tpu.observability.autoscale import Autoscaler, backlog_of
+
+
+def _report(backlog=0, wedged=False, pending=None, extra_hosts=()):
+    hosts = {"h0": {"gauges": {"ingest_backlog_docs": backlog}}}
+    for name, b in extra_hosts:
+        hosts[name] = {"gauges": {"ingest_backlog_docs": b}}
+    return {"hosts": hosts, "health": {"wedged": wedged},
+            "pending_work": pending}
+
+
+class _Fleet:
+    """Recording spawn/retire callables; handles are increasing ints."""
+
+    def __init__(self):
+        self.spawned, self.retired = [], []
+
+    def spawn(self):
+        h = len(self.spawned)
+        self.spawned.append(h)
+        return h
+
+    def retire(self, h):
+        self.retired.append(h)
+
+
+@pytest.fixture
+def clean_telemetry(monkeypatch):
+    for name in ("LDDL_TPU_METRICS_DIR", "LDDL_TPU_FLEET_DIR",
+                 "LDDL_TPU_FLEET_HOLDER", "LDDL_TPU_FLEET_TTL"):
+        monkeypatch.delenv(name, raising=False)
+    obs.registry().reset()
+    tracing._reset_for_tests()
+    fleet._reset_for_tests()
+    yield
+    obs.registry().reset()
+    tracing._reset_for_tests()
+    fleet._reset_for_tests()
+
+
+def _scaler(fl, **kw):
+    kw.setdefault("backlog_slo_docs", 100)
+    kw.setdefault("max_helpers", 2)
+    kw.setdefault("drain_rounds", 2)
+    return Autoscaler("/nowhere", fl.spawn, fl.retire, **kw)
+
+
+# ------------------------------------------------------------------ policy
+
+
+def test_backlog_of_takes_fleet_max():
+    rep = _report(backlog=5, extra_hosts=(("h1", 40), ("h2", 7)))
+    assert backlog_of(rep) == 40
+    assert backlog_of({"hosts": {"h0": {"gauges": {}}}}) == 0
+    assert backlog_of({}) == 0
+
+
+def test_scale_up_on_backlog_until_ceiling(clean_telemetry):
+    fl = _Fleet()
+    a = _scaler(fl)
+    assert a.observe(_report(backlog=500))["decision"] == "scale_up"
+    assert a.observe(_report(backlog=500))["decision"] == "scale_up"
+    # Ceiling: still hot, but max_helpers run already.
+    assert a.observe(_report(backlog=500))["decision"] is None
+    assert a.helper_count == 2 and fl.spawned == [0, 1]
+
+
+def test_scale_up_on_wedge_without_backlog(clean_telemetry):
+    fl = _Fleet()
+    a = _scaler(fl)
+    ob = a.observe(_report(backlog=0, wedged=True))
+    assert ob["decision"] == "scale_up"
+    assert a.decisions[-1] == ("scale_up", "wedged")
+
+
+def test_scale_down_needs_consecutive_calm_rounds(clean_telemetry):
+    fl = _Fleet()
+    a = _scaler(fl, drain_rounds=3)
+    a.observe(_report(backlog=500))
+    assert a.helper_count == 1
+    # calm, calm, NOT calm (pending work) -> the calm streak resets.
+    assert a.observe(_report())["decision"] is None
+    assert a.observe(_report())["decision"] is None
+    assert a.observe(_report(pending="delta preprocess"))["decision"] is None
+    assert a.observe(_report())["decision"] is None
+    assert a.observe(_report())["decision"] is None
+    assert a.observe(_report())["decision"] == "scale_down"
+    assert a.helper_count == 0 and fl.retired == [0]
+
+
+def test_scale_down_floor_and_lifo_retirement(clean_telemetry):
+    fl = _Fleet()
+    a = _scaler(fl, min_helpers=1, drain_rounds=1)
+    a.observe(_report(backlog=500))
+    a.observe(_report(backlog=500))
+    assert a.helper_count == 2
+    assert a.observe(_report())["decision"] == "scale_down"
+    assert fl.retired == [1]  # most recent helper leaves first
+    # Floor: min_helpers stays running however calm it gets.
+    assert a.observe(_report())["decision"] is None
+    assert a.helper_count == 1
+
+
+def test_shutdown_retires_everything(clean_telemetry):
+    fl = _Fleet()
+    a = _scaler(fl)
+    a.observe(_report(backlog=500))
+    a.observe(_report(backlog=500))
+    a.shutdown()
+    assert a.helper_count == 0
+    assert fl.retired == [1, 0]
+    assert [d for d in a.decisions if d[0] == "scale_down"] == \
+        [("scale_down", "service shutdown")] * 2
+
+
+def test_constructor_validation():
+    fl = _Fleet()
+    with pytest.raises(ValueError, match="backlog_slo_docs"):
+        Autoscaler("/x", fl.spawn, fl.retire, backlog_slo_docs=0,
+                   max_helpers=1)
+    with pytest.raises(ValueError, match="min_helpers"):
+        Autoscaler("/x", fl.spawn, fl.retire, backlog_slo_docs=1,
+                   max_helpers=1, min_helpers=2)
+
+
+# ------------------------------------------------------------- journaling
+
+
+def test_decisions_are_journaled_as_fleet_events(clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    spool = fleet.configure(root, holder_id="ctrl", ttl=5, interval=60)
+    fl = _Fleet()
+    a = _scaler(fl, drain_rounds=1)
+    a.observe(_report(backlog=500))
+    a.observe(_report())
+    fleet.flush_events()
+    events, torn = fleet.read_jsonl(os.path.join(
+        spool, "events-pid{}.jsonl".format(os.getpid())))
+    assert torn == 0
+    kinds = [ev["kind"] for ev in events]
+    assert "autoscale.scale_up" in kinds and "autoscale.scale_down" in kinds
+    up = events[kinds.index("autoscale.scale_up")]["args"]
+    assert up["backlog_docs"] == 500 and up["slo_docs"] == 100
+    c = obs.registry().counter("autoscale_decisions_total")
+    assert c.value(action="scale_up") == 1
+    assert c.value(action="scale_down") == 1
+
+
+def test_step_reads_real_aggregate(clean_telemetry, tmp_path):
+    """End-to-end through fleet.aggregate: a published backlog gauge in a
+    spool drives a real scale_up."""
+    root = str(tmp_path)
+    fleet.configure(root, holder_id="svc", ttl=5, interval=60)
+    obs.set_gauge("ingest_backlog_docs", 900)
+    fleet.heartbeat()
+    fl = _Fleet()
+    a = Autoscaler(root, fl.spawn, fl.retire, backlog_slo_docs=100,
+                   max_helpers=2, drain_rounds=2)
+    ob = a.step()
+    assert ob["backlog_docs"] == 900
+    assert ob["decision"] == "scale_up"
+    assert fl.spawned == [0]
+
+
+# ----------------------------------------------------- clock-free contract
+
+
+def test_autoscale_not_wall_clock_allowlisted():
+    """The analyzer must COVER autoscale.py: scale decisions derive from
+    the aggregate report, never from a clock read of their own. A glob
+    allow over observability/* would silently exempt it."""
+    from lddl_tpu.analysis.flow_rules import WallClockFlowRule
+    from lddl_tpu.analysis.rules import WallClockRule
+    for allow in (WallClockRule.allow, WallClockFlowRule.allow):
+        assert "lddl_tpu/observability/*" not in allow
+        assert not any("autoscale" in pat for pat in allow)

@@ -112,13 +112,15 @@ WALLCLOCK_HELPER = """
 
 def test_wall_clock_flow_interprocedural_true_positive(tmp_path):
     """A clock value laundered through a helper in an ALLOWLISTED file
-    (observability legitimately reads clocks) reaching manifest content —
-    invisible to the syntactic wall-clock rule, which never fires in
-    observability/ and sees no time.* at the manifest call site."""
+    (tracing legitimately reads clocks; the observability allowlist
+    names files individually so autoscale.py stays checked) reaching
+    manifest content — invisible to the syntactic wall-clock rule, which
+    never fires in the allowlisted file and sees no time.* at the
+    manifest call site."""
     report = run_tree(tmp_path, {
-        "lddl_tpu/observability/stamp.py": WALLCLOCK_HELPER,
+        "lddl_tpu/observability/tracing.py": WALLCLOCK_HELPER,
         "lddl_tpu/balance/manifest.py": """
-            from ..observability.stamp import now_tag
+            from ..observability.tracing import now_tag
 
             def build_manifest(names):
                 return {"tag": now_tag(), "shards": sorted(names)}

@@ -410,6 +410,132 @@ def test_elastic_pool_worker_death_is_reclaimed(fixture_dirs, goldens,
     assert gs.hash_outputs(out) == goldens["binned_masked"]
 
 
+def _fail_always(inner, flag_never):
+    class FailAlways:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def fingerprint(self):
+            return self.inner.fingerprint()
+
+        def __call__(self, texts, bucket):
+            if not os.path.exists(flag_never):
+                raise RuntimeError("host dies before finishing any bucket")
+            return self.inner(texts, bucket)
+
+    return FailAlways(inner)
+
+
+def test_adaptive_plan_crash_resume_byte_identity(fixture_dirs, goldens,
+                                                  tmp_path):
+    """Crash with a half-adapted plan on disk: the journaled plan record
+    survives while some main-unit records are gone (as a SIGKILLed fleet
+    leaves things). The resume must adopt the SAME plan — never recompute
+    a different partition under the same unit indices — redo only the
+    missing units, and finish byte-identical to the goldens."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag_never = str(tmp_path / "never")
+
+    # Phase 1 — a real adaptive run that dies at gather: probes, the plan
+    # record, and every scatter main are journaled in _done.
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out,
+                     _fail_always(_bert_processor(vocab, out), flag_never),
+                     "deadhost", ttl=0.3)
+    plan_path = os.path.join(out, "_done", "scatter-plan.json")
+    assert os.path.exists(plan_path)
+    with open(plan_path) as f:
+        plan1 = json.load(f)
+    assert plan1["main"] and all(len(r) == 2 for r in plan1["main"])
+    done = set(os.listdir(os.path.join(out, "_done")))
+    assert "scatter-p0.json" in done  # probe records carry fixed ids
+    assert "scatter-0.json" in done
+
+    # Phase 2 — half-adapt the wreckage: drop one probe record and one
+    # main record (their spool appends may survive; the sweep handles
+    # that), keeping the plan record itself.
+    os.remove(os.path.join(out, "_done", "scatter-p0.json"))
+    os.remove(os.path.join(out, "_done", "scatter-0.json"))
+
+    # Phase 3 — a survivor resumes, re-adopts the journaled plan, redoes
+    # the two missing units, and the bytes still match the goldens.
+    with open(flag_never, "w") as f:
+        f.write("alive\n")
+    _run_elastic(corpus, out,
+                 _fail_always(_bert_processor(vocab, out), flag_never),
+                 "survivor")
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    assert not os.path.isdir(os.path.join(out, "_done"))
+
+
+def test_adaptive_and_fixed_modes_refuse_cross_resume(fixture_dirs,
+                                                      tmp_path):
+    """The unit plan rides the resume fingerprint: an adaptive directory
+    refuses a fixed-unit join and vice versa — two hosts must never run
+    different partitions under the same unit indices."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    flag_never = str(tmp_path / "never")
+    proc = _bert_processor(vocab, out)
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out, _fail_always(proc, flag_never),
+                     "hostA", ttl=0.5)
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        _run_elastic(corpus, out, proc, "hostB", scatter_units=4)
+
+    out2 = str(tmp_path / "out2")
+    proc2 = _bert_processor(vocab, out2)
+    with pytest.raises(RuntimeError, match="re-run with resume"):
+        _run_elastic(corpus, out2, _fail_always(proc2, flag_never),
+                     "hostA", ttl=0.5, scatter_units=4)
+    with pytest.raises(ValueError, match="fingerprint mismatch"):
+        _run_elastic(corpus, out2, proc2, "hostB")
+
+
+def test_fixed_scatter_units_still_golden(fixture_dirs, goldens, tmp_path):
+    """An explicit --scatter-units pin (the classic fixed stride) remains
+    byte-identical to the goldens."""
+    td, corpus, vocab = fixture_dirs
+    out = str(tmp_path / "out")
+    _run_elastic(corpus, out, _bert_processor(vocab, out), "fixedhost",
+                 scatter_units=4)
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+
+
+def test_legacy_coordination_byte_identity(fixture_dirs, goldens, tmp_path,
+                                           monkeypatch):
+    """LDDL_TPU_COORD_LEGACY=1 (per-lease renewals, unsnapshotted claim
+    scans, barrier gather) against the batched/incremental default:
+    identical bytes for the pinned binned v1 goldens AND for a packed
+    schema-v2 pair run — the coordination rework must be invisible in
+    the output."""
+    td, corpus, vocab = fixture_dirs
+
+    legacy_out = str(tmp_path / "legacy")
+    monkeypatch.setenv("LDDL_TPU_COORD_LEGACY", "1")
+    _run_elastic(corpus, legacy_out, _bert_processor(vocab, legacy_out),
+                 "legacyhost", scatter_units=4)
+    assert gs.hash_outputs(legacy_out) == goldens["binned_masked"]
+
+    def packed_proc(out_dir):
+        from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+        from lddl_tpu.preprocess.runner import BertBucketProcessor
+        tok = get_tokenizer(vocab_file=vocab)
+        cfg = BertPretrainConfig(max_seq_length=32, masking=False,
+                                 schema_version=2)
+        return BertBucketProcessor(tok, cfg, 4242, out_dir, None, "parquet",
+                                   pack_seq_length=64, pack_max_per_row=4)
+
+    packed_legacy = str(tmp_path / "packed_legacy")
+    _run_elastic(corpus, packed_legacy, packed_proc(packed_legacy),
+                 "legacyhost", scatter_units=4)
+    monkeypatch.delenv("LDDL_TPU_COORD_LEGACY")
+    packed_new = str(tmp_path / "packed_new")
+    _run_elastic(corpus, packed_new, packed_proc(packed_new), "newhost")
+    assert gs.hash_outputs(packed_new) == gs.hash_outputs(packed_legacy)
+
+
 def test_elastic_no_global_shuffle(fixture_dirs, goldens, tmp_path):
     """Elastic block mode (no scatter phase): blocks are the units."""
     td, corpus, vocab = fixture_dirs

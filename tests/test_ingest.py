@@ -475,6 +475,56 @@ def test_crash_and_fs_order_equivalence(fixture_dirs, tok, tmp_path,
         _assert_same_batches(a, b)
 
 
+def test_join_pending_generation_completes_crashed_round(fixture_dirs, tok,
+                                                         tmp_path):
+    """The autoscaler's helper mode end to end: an elastic ingest round
+    dies mid-preprocess AFTER the intake record froze the doc set; a
+    join_pending_generation helper finishes the generation's elastic
+    preprocess from the journal alone (no landing scan, no journal
+    commit); the primary's resume then publishes the round, and the
+    bytes match a clean replay."""
+    from lddl_tpu.ingest import join_pending_generation
+    td, corpus, vocab = fixture_dirs
+    base = str(tmp_path)
+    clean = str(tmp_path / "clean")
+    dirty = str(tmp_path / "dirty")
+    _replay(clean, tok, base, corpus, (1, 2), **KW)
+
+    # Nothing in flight yet: the helper refuses politely.
+    _replay(dirty, tok, base, corpus, (1,), **KW)
+    rep = join_pending_generation(dirty, tok, config=_config())
+    assert rep["joined"] is False
+
+    landing = _landing(base, corpus, 2, name="landing-dirty")
+    faults.arm("sink-write:eio:p=1.0")
+    try:
+        with pytest.raises(RuntimeError, match="re-run with resume"):
+            ingest_once(dirty, tok, landing=landing, config=_config(),
+                        elastic=True, lease_ttl=5.0, holder_id="primary",
+                        **KW)
+    finally:
+        faults.disarm()
+
+    rep = join_pending_generation(dirty, tok, config=_config(),
+                                  lease_ttl=5.0, holder_id="helper")
+    assert rep["joined"] is True and rep["generation"] == 1
+    # The helper never commits the journal: the round is still pending.
+    assert Journal.load(dirty).pending_work() is not None
+    # A second helper finds the preprocess already finalized.
+    rep = join_pending_generation(dirty, tok, config=_config(),
+                                  lease_ttl=5.0, holder_id="helper2")
+    assert rep["joined"] is False
+
+    # Config drift refuses exactly like a mismatched resume.
+    with pytest.raises(ValueError, match="fingerprint"):
+        join_pending_generation(dirty, tok,
+                                config=_config(duplicate_factor=2))
+
+    ingest_once(dirty, tok, landing=landing, config=_config(),
+                elastic=True, lease_ttl=5.0, holder_id="primary", **KW)
+    assert _shard_hashes(dirty) == _shard_hashes(clean)
+
+
 KWP = dict(num_shards=4, seed=7, pack_seq_length=64, pack_max_per_row=8)
 
 

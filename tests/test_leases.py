@@ -223,6 +223,270 @@ def test_stall_kind_parses_with_long_default_delay():
     assert clause["delay"] == 2.5
 
 
+# ------------------------------------------- batched renewal / op budget
+
+
+@pytest.fixture
+def ops(monkeypatch, tmp_path):
+    """Arm metrics (counters are inert otherwise), reset the registry,
+    and return a reader for lease_ops_total."""
+    from lddl_tpu import observability as obs
+    monkeypatch.setenv("LDDL_TPU_METRICS_DIR", str(tmp_path / "metrics"))
+    obs.registry().reset()
+
+    def read(op=None):
+        c = obs.registry().counter("lease_ops_total")
+        return c.total() if op is None else c.value(op=op)
+
+    return read
+
+
+def test_scan_units_snapshot(root, ops):
+    for u in ("u0", "u1", "group-2"):
+        assert leases.try_acquire(root, u, "hostA", ttl_s=10.0) is not None
+    with open(os.path.join(root, "u9.json.tmp.123"), "w") as f:
+        f.write("debris")
+    before = ops(op="scan")
+    assert leases.scan_units(root) == {"u0", "u1", "group-2"}
+    assert ops(op="scan") == before + 1
+    assert leases.scan_units(str(root) + ".gone") is None
+
+
+def test_renew_fast_is_one_read_one_publish(root, ops):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    r0, p0 = ops(op="read"), ops(op="publish")
+    leases.renew_fast(lease, ttl_s=10.0)
+    assert ops(op="read") == r0 + 1      # legacy renew() does two
+    assert ops(op="publish") == p0 + 1
+    assert leases.verify(lease)
+
+
+def test_renew_fast_fences_stolen_lease(root):
+    """The batched pass keeps full fence semantics: a steal landing
+    before the grouped renewal marks the loser lost, and the loser's
+    publish never resurrects over the thief's record."""
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    leases._publish(leases.lease_path(root, "u0"),
+                    leases._record("u0", "thief", lease.epoch + 1,
+                                   time.time() + 30.0), "thief")
+    with pytest.raises(leases.LeaseLost):
+        leases.renew_fast(lease, ttl_s=10.0)
+    assert lease.lost
+    rec = leases.read_lease(root, "u0")
+    assert rec["holder"] == "thief" and rec["epoch"] == lease.epoch + 1
+
+
+def test_try_acquire_known_missing_skips_read(root, ops):
+    r0 = ops(op="read")
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0,
+                               known_missing=True)
+    assert lease is not None
+    # Exclusive create ONLY: the initial existence read was answered by
+    # the caller's scan snapshot, and batched mode also skips the
+    # post-create read-back (an O_EXCL winner's fresh record cannot be
+    # validly stolen before its deadline; the publish-time fence covers
+    # the stale-replace race the read-back merely narrowed).
+    assert ops(op="read") == r0
+    # Stale snapshot: the unit exists after all -> falls back to the read
+    # path and reports a clean conflict, never a crash or a double-claim.
+    assert leases.try_acquire(root, "u0", "hostB", ttl_s=10.0,
+                              known_missing=True) is None
+
+
+def test_try_acquire_held_cache_skips_filesystem(root, ops):
+    from lddl_tpu import observability as obs
+    assert leases.try_acquire(root, "u0", "hostA", ttl_s=10.0) is not None
+    cache = {}
+    assert leases.try_acquire(root, "u0", "hostB", ttl_s=10.0,
+                              held_cache=cache) is None
+    assert cache["u0"] > time.time()
+    t0, c0 = ops(), obs.registry().counter(
+        "lease_acquire_conflicts_total").total()
+    # Cached valid-held conflict: zero FS ops, no conflict counted.
+    assert leases.try_acquire(root, "u0", "hostB", ttl_s=10.0,
+                              held_cache=cache) is None
+    assert ops() == t0
+    assert obs.registry().counter(
+        "lease_acquire_conflicts_total").total() == c0
+    # An expired cache entry is dropped and the claim proceeds for real.
+    cache["u0"] = time.time() - 1.0
+    leases.release(leases.Lease(root, "u0", "hostA", 0,
+                                leases.read_lease(root, "u0")["deadline"]))
+    assert leases.try_acquire(root, "u0", "hostB", ttl_s=10.0,
+                              held_cache=cache) is not None
+
+
+def test_fence_at_deadline_cache_skips_reads(root, ops):
+    """Inside the cached deadline the fence is free; past it, a real read
+    refreshes the cache from the (renewed) record; a steal past the
+    deadline trips the fence on the first real read — and the trip is
+    final."""
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    fence = leases.fence_at(root, "u0", "hostA", 0,
+                            deadline=lease.deadline)
+    r0 = ops(op="read")
+    for _ in range(5):
+        assert fence()
+    assert ops(op="read") == r0  # all answered from the deadline cache
+    # An unseeded fence pays exactly one read, then caches the record's
+    # deadline for subsequent calls.
+    cold = leases.fence_at(root, "u0", "hostA", 0)
+    assert cold() and cold() and cold()
+    assert ops(op="read") == r0 + 1
+    # Past the deadline: a thief's record is detected on the real read.
+    late = leases.fence_at(root, "u0", "hostA", 0,
+                           now_fn=lambda: lease.deadline + 1.0)
+    leases._publish(leases.lease_path(root, "u0"),
+                    leases._record("u0", "thief", 1, time.time() + 30.0),
+                    "thief")
+    assert not late()
+    assert not late()  # tripped fences never recover
+
+
+def test_fence_at_stall_past_deadline_trips(root):
+    """The chaos scenario: a holder stalls past its deadline and a thief
+    steals. The stall itself carries the wall clock past the cached
+    deadline, so the first post-stall fence call is a REAL read and
+    self-terminates the zombie — same detection point as an every-call
+    read."""
+    victim = leases.try_acquire(root, "u0", "hostA", ttl_s=0.05)
+    fence = leases.fence_at(root, "u0", "hostA", 0,
+                            deadline=victim.deadline)
+    assert fence()  # inside the deadline: still ours
+    time.sleep(0.1)  # the "stall": deadline passes, nobody renews
+    thief = leases.try_acquire(root, "u0", "thief", ttl_s=10.0)
+    assert thief is not None and thief.epoch == 1
+    assert not fence()
+
+
+def test_still_held_skips_read_inside_deadline(root, ops):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    t0 = ops()
+    assert leases.still_held(lease)
+    assert ops() == t0  # deadline ahead: zero FS ops
+    # A lost flag wins without any read.
+    lease.lost = True
+    assert not leases.still_held(lease)
+    assert ops() == t0
+    # Past the deadline the look is a real verify read.
+    lease.lost = False
+    lease.deadline = time.time() - 1.0
+    assert leases.still_held(lease)  # record on disk still names us
+    assert ops() > t0
+
+
+def test_release_inside_deadline_is_unlink_only(root, ops):
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0)
+    r0, u0 = ops(op="read"), ops(op="unlink")
+    leases.release(lease)
+    assert ops(op="read") == r0  # no pre-unlink verify read
+    assert ops(op="unlink") == u0 + 1
+    assert leases.read_lease(root, "u0") is None
+
+
+def test_legacy_pins_read_backed_acquire_and_fence(root, ops, monkeypatch):
+    """LDDL_TPU_COORD_LEGACY=1 restores the ancestor op pattern: acquire
+    read-back, every-call fence reads, verified release."""
+    monkeypatch.setenv("LDDL_TPU_COORD_LEGACY", "1")
+    r0 = ops(op="read")
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=10.0,
+                               known_missing=True)
+    assert lease is not None
+    assert ops(op="read") == r0 + 1  # the post-create read-back
+    fence = leases.fence_at(root, "u0", "hostA", 0,
+                            deadline=lease.deadline)
+    r1 = ops(op="read")
+    assert fence() and fence()
+    assert ops(op="read") == r1 + 2  # one real read per call
+    r2 = ops(op="read")
+    assert leases.still_held(lease)
+    assert ops(op="read") == r2 + 1  # pre-publish look reads too
+    r3 = ops(op="read")
+    leases.release(lease)
+    assert ops(op="read") == r3 + 1  # verified unlink
+
+
+def test_batched_keeper_pass_op_budget(root, ops):
+    """One keeper pass over n held leases costs 1 scan + 2n ops (the
+    ≥3x amortization the batched pass exists for), and keeps them alive."""
+    held = [leases.try_acquire(root, "u{}".format(i), "hostA", ttl_s=0.4)
+            for i in range(4)]
+    assert all(held)
+    t0 = ops()
+    keeper = leases.LeaseKeeper(0.4)
+    try:
+        for lease in held:
+            keeper.add(lease)
+        time.sleep(1.0)  # several TTLs: only batched renewals keep them
+        assert all(leases.verify(x) and not x.lost for x in held)
+    finally:
+        keeper.stop()
+    passes = ops(op="scan")  # one scan per pass (single root)
+    assert passes >= 1
+    # 2n (read+publish) per pass per survivor, +1 scan — strictly under
+    # the 3n-per-pass legacy budget. The verify() sweep above cost one
+    # read per lease inside the measurement window.
+    spent = ops() - t0 - len(held)
+    assert spent <= passes * (1 + 2 * len(held))
+
+
+def test_batched_keeper_marks_missing_lease_lost_without_read(root):
+    """A lease file missing from the pass's scan (stolen-then-released,
+    or finalized) is marked lost from the snapshot alone."""
+    keep = leases.try_acquire(root, "ukeep", "hostA", ttl_s=0.4)
+    gone = leases.try_acquire(root, "ugone", "hostA", ttl_s=0.4)
+    keeper = leases.LeaseKeeper(0.4)
+    try:
+        keeper.add(keep)
+        keeper.add(gone)
+        os.unlink(gone.path)
+        deadline = time.time() + 3.0
+        while not gone.lost and time.time() < deadline:
+            time.sleep(0.05)
+        assert gone.lost
+        assert leases.verify(keep) and not keep.lost
+    finally:
+        keeper.stop()
+
+
+def test_batched_keeper_fences_steal_between_renewals(root):
+    """A thief's record lands between grouped renewals: the file is still
+    present in the scan, so the fence inside renew_fast must catch it."""
+    victim = leases.try_acquire(root, "u0", "hostA", ttl_s=0.4)
+    other = leases.try_acquire(root, "u1", "hostA", ttl_s=0.4)
+    keeper = leases.LeaseKeeper(0.4)
+    try:
+        keeper.add(victim)
+        keeper.add(other)
+        leases._publish(leases.lease_path(root, "u0"),
+                        leases._record("u0", "thief", victim.epoch + 1,
+                                       time.time() + 30.0), "thief")
+        deadline = time.time() + 3.0
+        while not victim.lost and time.time() < deadline:
+            time.sleep(0.05)
+        assert victim.lost
+        rec = leases.read_lease(root, "u0")
+        assert rec["holder"] == "thief"  # never resurrected over the thief
+        assert leases.verify(other) and not other.lost
+    finally:
+        keeper.stop()
+
+
+def test_legacy_coordination_env_pin(root, monkeypatch):
+    assert not leases.legacy_coordination()
+    monkeypatch.setenv("LDDL_TPU_COORD_LEGACY", "1")
+    assert leases.legacy_coordination()
+    # The legacy keeper path still keeps leases alive.
+    lease = leases.try_acquire(root, "u0", "hostA", ttl_s=0.4)
+    keeper = leases.LeaseKeeper(0.4)
+    try:
+        keeper.add(lease)
+        time.sleep(1.0)
+        assert leases.verify(lease) and not lease.lost
+    finally:
+        keeper.stop()
+
+
 def test_holder_sanitization():
     assert leases.sanitize_holder("host a/b:1") == "host-a-b-1"
     with pytest.raises(ValueError):

@@ -112,6 +112,44 @@ def load_sink_overlap(repo_root):
     return out
 
 
+def load_coordination(repo_root):
+    """The elastic coordination-cost and autoscale-episode blocks from
+    SCALE_RUN.json (lease filesystem ops per unit, legacy vs batched;
+    gather overlap; steal latency; the recorded scale_up/scale_down
+    episode), or None when the artifact predates phase 7."""
+    path = os.path.join(repo_root, "SCALE_RUN.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    phases = doc.get("phases") or {}
+    coord = phases.get("coordination_cost")
+    if not isinstance(coord, dict):
+        return None
+    out = {
+        "ops_per_unit_legacy": (coord.get("legacy") or {}).get(
+            "ops_per_unit"),
+        "ops_per_unit_batched": (coord.get("batched_adaptive") or {}).get(
+            "ops_per_unit"),
+        "ops_per_unit_ratio": coord.get("ops_per_unit_ratio"),
+        "gather_overlap_s": (coord.get("batched_adaptive") or {}).get(
+            "gather_overlap_s"),
+        "steal_latency_s_median": (coord.get("steal_leg") or {}).get(
+            "steal_latency_s_median"),
+        "host_can_show_scaling": coord.get("host_can_show_scaling"),
+    }
+    episode = phases.get("autoscale_episode")
+    if isinstance(episode, dict):
+        out["autoscale"] = {
+            "decisions_total": episode.get("decisions_total"),
+            "helper_joined_generation": episode.get(
+                "helper_joined_generation"),
+            "backlog_slo_docs": episode.get("backlog_slo_docs"),
+        }
+    return out
+
+
 def load_loader_bench(repo_root):
     path = os.path.join(repo_root, "LOADER_BENCH.json")
     try:
@@ -157,6 +195,7 @@ def main(argv=None):
         "preprocess_verdict": verdict(series),
         "loader": load_loader_bench(args.repo_root),
         "sink_overlap": load_sink_overlap(args.repo_root),
+        "coordination": load_coordination(args.repo_root),
     }
     if args.json:
         print(json.dumps(result, indent=2, sort_keys=True))
@@ -219,6 +258,25 @@ def main(argv=None):
             line += "; single-worker {} -> {} MB/s".format(
                 overlap["previous_mb_per_s"], overlap["producer_mb_per_s"])
         print(line)
+    coord = result["coordination"]
+    if coord:
+        print("elastic coordination (SCALE_RUN phase 7): lease FS "
+              "ops/unit {} legacy -> {} batched ({}x), gather overlap "
+              "{}s, steal latency median {}s{}".format(
+                  coord.get("ops_per_unit_legacy"),
+                  coord.get("ops_per_unit_batched"),
+                  coord.get("ops_per_unit_ratio"),
+                  coord.get("gather_overlap_s"),
+                  coord.get("steal_latency_s_median"),
+                  "" if coord.get("host_can_show_scaling")
+                  else " [host too small to show scaling]"))
+        scale = coord.get("autoscale")
+        if scale:
+            print("autoscale episode (phase 8): decisions {} at SLO {} "
+                  "docs, helper joined in-flight generation: {}".format(
+                      scale.get("decisions_total"),
+                      scale.get("backlog_slo_docs"),
+                      scale.get("helper_joined_generation")))
     return 0
 
 

@@ -67,6 +67,17 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
         echo "ci_check: sink smoke FAILED — serial/async divergence or crash" >&2
         exit 1
     fi
+    # Elastic coordination smoke: two worksteal processes, legacy vs
+    # batched coordination, on a tiny corpus. The byte-identity half is
+    # gating (the lease protocol must never reach shard bytes); the
+    # lease-ops-per-unit ratio it prints is informational — the
+    # committed SCALE_RUN.json phase 7 is the measurement of record.
+    if JAX_PLATFORMS=cpu python benchmarks/elastic_smoke.py; then
+        echo "ci_check: elastic coordination smoke OK (ratio non-gating)"
+    else
+        echo "ci_check: elastic smoke FAILED — legacy/batched divergence or crash" >&2
+        exit 1
+    fi
 fi
 
 # Opt-in native-engine smoke: builds the C++ engine from source and runs
