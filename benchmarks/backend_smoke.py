@@ -1,0 +1,155 @@
+"""CI smoke for the pluggable storage backend: one small corpus taken
+through the full preprocess -> balance -> load round trip twice — once
+on the default LocalBackend, once on the MockObjectStore
+(``--storage-backend mock``) — with byte identity asserted end to end.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_BENCH=1``. The
+byte-identity half is GATING: the storage backend is coordination and
+publish *plumbing* and must never reach shard bytes (the invariant
+tests/test_backend.py pins in-process; this smoke pins it across the
+real CLI surface, worker spawn env inheritance included). The wall
+times are informational only — the mock store pays multipart staging +
+commit-record IO by design and is not a performance claim. Prints one
+JSON line::
+
+    {"identical": true, "shards": N, "samples": {"local": n, "mock": n},
+     "wall_s": {"local": ..., "mock": ...}, "loader_identical": true}
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+
+def _tree_digests(out_dir):
+    """sha256 of every published (visible) file under ``out_dir``,
+    keyed by relative path. Mock-store sidecar dirs (``.obj.*``) and
+    telemetry/scratch are implementation detail, not published state —
+    the identity claim is about what a data-plane consumer can read."""
+    out = {}
+    # Deterministic by construction: dirnames are pruned+sorted in place
+    # (os.walk honors that) and filenames sorted before hashing.
+    for dirpath, dirnames, filenames in os.walk(out_dir):  # lddl: disable=unsorted-iteration
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith((".obj.", ".telemetry",
+                                                  ".tmp.")))
+        for name in sorted(filenames):
+            if name.startswith(".") or ".tmp." in name:
+                continue
+            path = os.path.join(dirpath, name)
+            h = hashlib.sha256()
+            with open(path, "rb") as f:
+                for chunk in iter(lambda: f.read(1 << 20), b""):
+                    h.update(chunk)
+            out[os.path.relpath(path, out_dir)] = h.hexdigest()
+    return out
+
+
+def _load_samples(bal_dir, vocab):
+    """Stream every balanced shard through the real loader; return
+    (n_samples, digest-of-batch-tensors) so load-path equivalence is
+    checked on decoded tensors, not just file bytes."""
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+
+    loader = get_bert_pretrain_data_loader(
+        bal_dir, vocab_file=vocab, batch_size=8, num_workers=0)
+    h = hashlib.sha256()
+    n = 0
+    for batch in loader:
+        for key in sorted(batch):
+            h.update(key.encode())
+            h.update(bytes(memoryview(batch[key]).cast("B")))
+        n += int(batch["input_ids"].shape[0])
+    return n, h.hexdigest()
+
+
+def main():
+    target_mb = float(os.environ.get("LDDL_TPU_BACKEND_SMOKE_MB", "1"))
+    tmp = tempfile.mkdtemp(prefix="lddl_backend_smoke_")
+    try:
+        from lddl_tpu.preprocess import build_wordpiece_vocab
+
+        corpus = os.path.join(tmp, "corpus")
+        bench.make_corpus(corpus, target_mb, seed=0)
+        sample = []
+        sample_bytes = 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 300_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=8000)
+
+        report = {"wall_s": {}, "samples": {}}
+        pre_digests = {}
+        bal_digests = {}
+        loads = {}
+        for name in ("local", "mock"):
+            pre = os.path.join(tmp, "pre_" + name)
+            bal = os.path.join(tmp, "bal_" + name)
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            # The flag (not the env var) is the surface under test: it
+            # must pin the env for the CLI's own workers itself.
+            env.pop("LDDL_TPU_STORAGE_BACKEND", None)
+            t0 = time.perf_counter()
+            for cmd in (
+                [sys.executable, "-m",
+                 "lddl_tpu.cli.preprocess_bert_pretrain",
+                 "--wikipedia", corpus, "--sink", pre,
+                 "--vocab-file", vocab, "--masking",
+                 "--bin-size", "32", "--num-blocks", "8",
+                 "--seed", "7", "--local-workers", "2",
+                 "--storage-backend", name],
+                [sys.executable, "-m", "lddl_tpu.cli.balance_shards",
+                 "--indir", pre, "--outdir", bal, "--num-shards", "4",
+                 "--storage-backend", name],
+            ):
+                rc = subprocess.call(cmd, env=env,
+                                     stdout=subprocess.DEVNULL,
+                                     stderr=subprocess.STDOUT)
+                if rc != 0:
+                    print("backend smoke: {} leg failed rc={} ({})".format(
+                        name, rc, cmd[2]), file=sys.stderr)
+                    return 1
+            report["wall_s"][name] = round(time.perf_counter() - t0, 1)
+            pre_digests[name] = _tree_digests(pre)
+            bal_digests[name] = _tree_digests(bal)
+            os.environ["LDDL_TPU_STORAGE_BACKEND"] = name
+            try:
+                n, digest = _load_samples(bal, vocab)
+            finally:
+                os.environ.pop("LDDL_TPU_STORAGE_BACKEND", None)
+            report["samples"][name] = n
+            loads[name] = digest
+        report["shards"] = sum(1 for p in bal_digests["local"]
+                               if ".parquet" in p)
+        report["identical"] = (
+            bool(pre_digests["local"])
+            and pre_digests["local"] == pre_digests["mock"]
+            and bal_digests["local"] == bal_digests["mock"])
+        report["loader_identical"] = (loads["local"] == loads["mock"]
+                                      and report["samples"]["local"] > 0)
+        print(json.dumps(report, sort_keys=True))
+        if not (report["identical"] and report["loader_identical"]):
+            print("backend smoke: local and mock backends shipped "
+                  "DIFFERENT bytes", file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -156,6 +156,11 @@ def main():
             "mb_per_s_single_worker": round(nbytes / 1024 / 1024 / elapsed,
                                             3),
             "host_calibration_s": bench.host_calibration(),
+            # Same stamp SCALE_RUN/LOADER_BENCH carry: whether this
+            # measurement host had the cores to show parallel scaling
+            # (a 1-core CI box profiles attribution fine but its MB/s
+            # must not be read as a multi-worker claim).
+            "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
             "sinks_tottime_s": {
                 k: {"s": round(v, 3), "share_pct": round(100 * v / total, 1)}
                 for k, v in sorted(sinks.items(), key=lambda kv: -kv[1])},

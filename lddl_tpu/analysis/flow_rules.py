@@ -25,9 +25,12 @@ SHARD_PKGS = ("lddl_tpu/preprocess/*", "lddl_tpu/balance/*",
               "lddl_tpu/loader/*", "lddl_tpu/resilience/*",
               "lddl_tpu/ingest/*", "lddl_tpu/utils/fs.py")
 
-# The sanctioned atomic publisher: its internals ARE the tmp+fsync+replace
-# dance, and effects never propagate out of it.
-SANCTIONED = ("lddl_tpu/resilience/io.py",)
+# The sanctioned atomic publishers: io.py's internals ARE the
+# tmp+fsync+replace dance and backend.py's ARE the object-store
+# multipart-upload-then-commit dance; effects never propagate out of
+# them. A raw write laundered AROUND the backend is a finding.
+SANCTIONED = ("lddl_tpu/resilience/io.py",
+              "lddl_tpu/resilience/backend.py")
 
 # Files whose raw writes never land in shard directories by construction
 # (trace/metrics files and the fleet-telemetry spools under .telemetry/,
@@ -72,8 +75,11 @@ class WallClockFlowRule(FlowRule):
              "lddl_tpu/observability/__init__.py",
              "benchmarks/*",
              # tmp-file names embed the pid on purpose: the pre-publish
-             # scratch name is never part of the published state.
+             # scratch name is never part of the published state (same
+             # for backend.py's upload ids and part names — staging
+             # identity, never object content).
              "lddl_tpu/resilience/io.py",
+             "lddl_tpu/resilience/backend.py",
              # Lease deadlines/holder ids are wall-clock BY DESIGN (the
              # one cross-host time base a shared FS offers); the
              # lease-isolation rule — not this one — guards the boundary
@@ -112,7 +118,8 @@ class PublishPathFlowRule(FlowRule):
            "enqueued via preprocess/sink.py is treated as called at the "
            "enqueue site (dataflow.DEFERRED_CALL_MODULE_SUFFIXES), so "
            "deferring a raw write cannot launder it past the rule")
-    allow = ("lddl_tpu/resilience/io.py",)
+    allow = ("lddl_tpu/resilience/io.py",
+             "lddl_tpu/resilience/backend.py")
 
 
 @register

@@ -70,6 +70,27 @@ def attach_elastic_args(parser):
              "(the unit plan rides the resume fingerprint either way)")
 
 
+def attach_storage_arg(parser):
+    parser.add_argument(
+        "--storage-backend", choices=("local", "mock"), default=None,
+        help="durable-IO/coordination backend (resilience/backend.py): "
+             "'local' = the POSIX shared filesystem (default; atomic-"
+             "rename leases, rename publishes), 'mock' = the in-process "
+             "object store with CAS leases and multipart-upload-then-"
+             "commit publishes (chaos/CI validation only). Equivalent to "
+             "LDDL_TPU_STORAGE_BACKEND; inherited by worker processes")
+
+
+def apply_storage_backend(args):
+    """Pin the selected backend into the environment BEFORE any run
+    kwargs are snapshotted or workers spawn (env-based, so pool/loader
+    children inherit it — same pattern as fault arming)."""
+    name = getattr(args, "storage_backend", None)
+    if name:
+        from ..resilience import backend as storage
+        storage.set_backend(name)
+
+
 def attach_fleet_arg(parser):
     parser.add_argument(
         "--fleet-telemetry", action="store_true",

@@ -10,8 +10,9 @@ resumes from its intake record, and the journal commit is atomic.
 
 from ..preprocess import BertPretrainConfig, get_tokenizer
 from ..utils.args import attach_bool_arg
-from .common import (arm_fleet_if_requested, attach_elastic_args,
-                     attach_fleet_arg, elastic_kwargs_of, make_parser)
+from .common import (apply_storage_backend, arm_fleet_if_requested,
+                     attach_elastic_args, attach_fleet_arg,
+                     attach_storage_arg, elastic_kwargs_of, make_parser)
 
 
 def attach_args(parser=None):
@@ -102,6 +103,7 @@ def attach_args(parser=None):
                              "the landing dir or commits the journal")
     attach_elastic_args(parser)
     attach_fleet_arg(parser)
+    attach_storage_arg(parser)
     return parser
 
 
@@ -142,9 +144,10 @@ def main(args=None):
     args = args if args is not None else attach_args().parse_args()
     if args.vocab_file is None and args.tokenizer is None:
         raise SystemExit("need --vocab-file or --tokenizer")
-    # Arm BEFORE snapshotting the elastic kwargs: on an elastic run
-    # with no --elastic-host-id this pins the auto-generated lease
-    # holder into args so spool and lease files share a name.
+    # Pin the storage backend into the env first (workers and helper
+    # subprocesses inherit it), then arm fleet BEFORE snapshotting the
+    # elastic kwargs (see the bert CLI).
+    apply_storage_backend(args)
     arm_fleet_if_requested(args, args.sink)
     elastic_kwargs = elastic_kwargs_of(args)
     tokenizer = get_tokenizer(vocab_file=args.vocab_file,

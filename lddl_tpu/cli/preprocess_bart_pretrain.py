@@ -6,9 +6,10 @@ Reference parity: the ``preprocess_bart_pretrain`` console script
 
 from ..preprocess import BartPretrainConfig, run_bart_preprocess
 from ..utils.args import attach_bool_arg
-from .common import (arm_fleet_if_requested, attach_corpus_args,
-                     attach_elastic_args, attach_fleet_arg,
-                     attach_multihost_arg, communicator_of,
+from .common import (apply_storage_backend, arm_fleet_if_requested,
+                     attach_corpus_args, attach_elastic_args,
+                     attach_fleet_arg, attach_multihost_arg,
+                     attach_storage_arg, communicator_of,
                      corpus_paths_of, elastic_kwargs_of, make_parser)
 
 
@@ -18,6 +19,7 @@ def attach_args(parser=None):
     attach_multihost_arg(parser)
     attach_elastic_args(parser)
     attach_fleet_arg(parser)
+    attach_storage_arg(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True)
     parser.add_argument("--vocab-file", default=None,
                         help="emit schema-v2 token-id columns "
@@ -53,9 +55,10 @@ def attach_args(parser=None):
 def main(args=None):
     import os
     args = args if args is not None else attach_args().parse_args()
-    # Arm BEFORE snapshotting the elastic kwargs: on an elastic run
-    # with no --elastic-host-id this pins the auto-generated lease
-    # holder into args so spool and lease files share a name.
+    # Pin the storage backend into the env first (workers inherit it),
+    # then arm fleet BEFORE snapshotting the elastic kwargs (see the
+    # bert CLI).
+    apply_storage_backend(args)
     arm_fleet_if_requested(args, args.sink)
     elastic_kwargs = elastic_kwargs_of(args)
     comm = communicator_of(args)

@@ -8,9 +8,10 @@ the static-scheduled runner's (--num-blocks, --multihost) and the new
 
 from ..preprocess import BertPretrainConfig, get_tokenizer, run_bert_preprocess
 from ..utils.args import attach_bool_arg
-from .common import (arm_fleet_if_requested, attach_corpus_args,
-                     attach_elastic_args, attach_fleet_arg,
-                     attach_multihost_arg, communicator_of,
+from .common import (apply_storage_backend, arm_fleet_if_requested,
+                     attach_corpus_args, attach_elastic_args,
+                     attach_fleet_arg, attach_multihost_arg,
+                     attach_storage_arg, communicator_of,
                      corpus_paths_of, elastic_kwargs_of, make_parser)
 
 
@@ -20,6 +21,7 @@ def attach_args(parser=None):
     attach_multihost_arg(parser)
     attach_elastic_args(parser)
     attach_fleet_arg(parser)
+    attach_storage_arg(parser)
     parser.add_argument("--sink", "--outdir", dest="sink", required=True,
                         help="output directory for the parquet shards")
     parser.add_argument("--vocab-file", default=None)
@@ -86,9 +88,11 @@ def main(args=None):
     args = args if args is not None else attach_args().parse_args()
     if args.vocab_file is None and args.tokenizer is None:
         raise SystemExit("need --vocab-file or --tokenizer")
-    # Arm BEFORE snapshotting the elastic kwargs: on an elastic run
-    # with no --elastic-host-id this pins the auto-generated lease
-    # holder into args so spool and lease files share a name.
+    # Pin the storage backend into the env first (workers inherit it),
+    # then arm fleet BEFORE snapshotting the elastic kwargs: on an
+    # elastic run with no --elastic-host-id this pins the auto-generated
+    # lease holder into args so spool and lease files share a name.
+    apply_storage_backend(args)
     arm_fleet_if_requested(args, args.sink)
     elastic_kwargs = elastic_kwargs_of(args)
     comm = communicator_of(args)

@@ -388,13 +388,13 @@ def _ingest_once_body(root, tokenizer, landing, files, config, num_shards,
     # consumed carry inputs, then the whole work dir.
     cdir = journal_mod.carry_dir(root)
     keep = set(journal.carry.values())
-    if os.path.isdir(cdir):
-        for name in sorted(os.listdir(cdir)):
-            if name not in keep:
-                try:
-                    os.remove(os.path.join(cdir, name))
-                except FileNotFoundError:
-                    pass
+    # Backend-routed sweep: on the mock store the carry files are
+    # objects, and a raw unlink of only the view would leave them
+    # readable through their commit records (silent resurrection).
+    names = rio.list_dir(cdir)
+    for name in names or ():
+        if name not in keep:
+            rio.remove(os.path.join(cdir, name))
     shutil.rmtree(wdir, ignore_errors=True)
 
     carry_rows = sum(

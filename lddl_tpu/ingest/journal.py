@@ -96,13 +96,35 @@ def read_record(path):
     return rec, status
 
 
-def publish_record(path, payload):
+def publish_record(path, payload, exclusive=False):
     """Atomically publish one journal record (``journal-publish`` fault
     site). ``payload`` must already be deterministic content — every
-    caller serializes with sort_keys."""
+    caller serializes with sort_keys.
+
+    ``exclusive=True`` marks a record that must commit exactly once (the
+    per-generation segment — THE ingest commit point). On the local
+    backend that stays today's atomic write (ingest is single-writer by
+    contract; the segment hole/torn checks guard the sequence). On a CAS
+    backend (resilience/backend.py) it becomes a conditional create: a
+    raced duplicate commit of IDENTICAL content is idempotent and
+    absorbed, while conflicting content for the same generation refuses
+    loudly instead of silently overwriting the authoritative record."""
     faults.fault_point("journal-publish", path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    rio.atomic_write(path, json.dumps(payload, sort_keys=True))
+    data = json.dumps(payload, sort_keys=True)
+    if exclusive:
+        if rio.put_exclusive(path, data) == "conflict":
+            current, status = rio.read_json(path)
+            if status == "ok" and current == payload:
+                obs.inc("ingest_journal_idempotent_commits_total")
+                return
+            raise ValueError(
+                "conflicting concurrent commit of journal record {}: "
+                "another writer already published DIFFERENT content for "
+                "this generation — refusing to overwrite the "
+                "authoritative segment".format(path))
+        return
+    rio.atomic_write(path, data)
 
 
 class Journal:
@@ -222,7 +244,8 @@ class Journal:
             "docs": len(hashes),
             "doc_bytes": int(doc_bytes),
         }
-        publish_record(segment_path(self.root, generation), payload)
+        publish_record(segment_path(self.root, generation), payload,
+                       exclusive=True)
         for h in hashes:
             self.entries[h] = generation
         self.generation = generation

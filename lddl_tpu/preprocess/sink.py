@@ -74,9 +74,16 @@ _STATS = {"write_s": 0.0, "stall_s": 0.0, "tasks": 0, "units": 0,
 
 
 def stats_snapshot():
-    """Copy of the process-cumulative sink stats (profiling aid)."""
+    """Copy of the process-cumulative sink stats (profiling aid), tagged
+    with the storage backend the deferred publishes route through
+    (resilience/backend.py — write_table_atomic/atomic_write inside each
+    closure dispatch on it, so 'which store did these seconds go to' is
+    part of the measurement's identity)."""
+    from ..resilience import backend as storage
     with _STATS_LOCK:
-        return dict(_STATS)
+        snap = dict(_STATS)
+    snap["storage_backend"] = storage.active_name()
+    return snap
 
 
 def _stats_add(**deltas):

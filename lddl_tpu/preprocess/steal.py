@@ -131,10 +131,9 @@ def _publish_scatter_record(out_dir, unit, lease, wall=None):
     if not leases.verify(lease):
         cur = _read_scatter_record(out_dir, unit)
         if cur == record:
-            try:
-                os.remove(path)
-            except FileNotFoundError:
-                pass
+            # Backend-routed withdrawal: on the mock store a raw unlink
+            # would leave the record's commit records readable.
+            rio.remove(path)
         _prune_empty_scaffolding(out_dir)
         return False
     return record
@@ -165,10 +164,7 @@ def _publish_gather_record(out_dir, unit, result, lease):
     incremental gather can consume it without re-reading the ledger."""
     _runner._ledger_write(out_dir, unit, result)
     if not leases.verify(lease):
-        try:
-            os.remove(_runner._ledger_path(out_dir, unit))
-        except FileNotFoundError:
-            pass
+        rio.remove(_runner._ledger_path(out_dir, unit))
         _prune_empty_scaffolding(out_dir)
         return False
     return result
@@ -344,10 +340,7 @@ def _ensure_plan(spec, probes, nblocks, holder, ttl, keeper, poll, log):
             if not leases.verify(lease):
                 cur = _read_plan_record(out_dir)
                 if cur == plan:
-                    try:
-                        os.remove(path)
-                    except FileNotFoundError:
-                        pass
+                    rio.remove(path)
                 _prune_empty_scaffolding(out_dir)
                 continue
             log("elastic scatter: adaptive plan journaled ({} probe(s) + "
@@ -573,14 +566,16 @@ def claim_loop(spec, phase, unit_prefix, units, *, holder, ttl, keeper,
             on_record(unit, rec)
 
     def list_ledger():
-        """One listdir of ``_done`` per scan pass: a name absent from the
-        snapshot is definitely not journaled (records only ever appear;
-        they are withdrawn so rarely the next pass absorbs it), so the
-        per-unit is_done read is skipped for it."""
-        try:
-            return set(os.listdir(ledger_dir))
-        except (FileNotFoundError, NotADirectoryError):
-            return set()
+        """One listing of ``_done`` per scan pass (backend-routed: on the
+        mock store this is the ``list`` fault site, so chaos runs can
+        serve a stale snapshot here): a name absent from the snapshot is
+        definitely not journaled (records only ever appear; they are
+        withdrawn so rarely the next pass absorbs it), so the per-unit
+        is_done read is skipped for it. A STALE listing only delays
+        discovery by one pass — record reads, not listings, are what the
+        claim loop trusts for done-ness."""
+        names = rio.list_dir(ledger_dir)
+        return set() if names is None else set(names)
 
     def run_finalized():
         """True once another host's finalize has retired the ledger. The

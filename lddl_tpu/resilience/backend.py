@@ -1,0 +1,612 @@
+"""Pluggable storage backend: POSIX shared filesystem vs mock object store.
+
+Every coordination mechanism in the pipeline — atomic-rename leases, the
+``.ingest/`` journal, two-phase shard publish — historically assumed a
+POSIX shared filesystem (the one LDDL deployment constraint PAPER.md
+inherits). Production fleets increasingly mount object stores instead,
+where rename is not atomic, reads can be stale, and puts fail in ways NFS
+never does. This module is the seam between those worlds:
+
+- :class:`LocalBackend` — the default. A thin veneer over
+  ``resilience/io``'s existing primitives; the hot path in ``io.py`` does
+  NOT dispatch through it (the local branches there are the pre-backend
+  code verbatim — zero new syscalls), so selecting ``local`` is
+  byte-identical and cost-identical to the pre-backend pipeline.
+- :class:`MockObjectStore` — an in-process object store with object-store
+  semantics: **no rename** (objects appear only via
+  multipart-upload-then-commit), **versioned objects** (every commit is a
+  new immutable generation; conditional ops compare generations, the moral
+  equivalent of an ETag), and a fault program driven by the existing
+  ``LDDL_TPU_FAULTS`` injector (``cas-put`` / ``range-read`` /
+  ``multipart-commit`` / ``list`` sites) so the chaos suite can replay
+  the SIGKILL matrix against CAS conflicts, torn multipart uploads,
+  list-after-put staleness, and 5xx-shaped transients.
+
+Mock store on-disk layout (disk-backed so the 3-host chaos runs — real
+processes sharing only the output directory — coordinate through it
+exactly like they would through a real store; "in-process" means no
+external server, not no disk)::
+
+    <dir>/.obj.<name>/u<pid>-<seq>.p<k>   uploaded parts (staging;
+                                          orphans = abandoned multipart)
+    <dir>/.obj.<name>/g<00000042>.json    commit record for generation 42
+                                          (atomic exclusive create: the
+                                          ONE winner per generation)
+    <dir>/<name>                          materialized read view of the
+                                          newest committed generation
+
+The commit record is the linearization point: it is hard-linked into
+place from a fully-written temp (``os.link`` fails loudly on EEXIST even
+on NFS), so exactly one writer wins each generation — that exclusive
+create IS the store's compare-and-swap. The materialized view exists so
+unchanged data-plane readers (loader, balancer, integrity checks) keep
+reading plain files; coordination reads (leases, CAS chains) always
+resolve through the commit records, which are authoritative. A crash
+between commit and materialize leaves a committed-but-unmirrored object:
+readers through the backend see the commit, raw existence checks lag one
+step — the same window a real store's list-after-put staleness opens, and
+the pipeline's redo-idempotence absorbs both.
+
+Selection is ENV-VAR based (``LDDL_TPU_STORAGE_BACKEND``: ``local`` |
+``mock``) so spawned pool/loader workers inherit the backend
+automatically; CLIs expose it as ``--storage-backend``.
+
+Counters: ``backend_ops_total{backend,op,outcome}`` for every backend
+operation and ``backend_cas_conflicts_total`` for every conditional-put /
+conditional-delete precondition loss (injected or real).
+"""
+
+import errno
+import json
+import os
+import shutil
+import threading
+
+from . import faults
+from ..observability import inc as obs_inc
+
+ENV_VAR = "LDDL_TPU_STORAGE_BACKEND"
+BACKENDS = ("local", "mock")
+
+OBJ_PREFIX = ".obj."
+
+# "No precondition" sentinel for internal put plumbing (None already means
+# "object must not exist", so a third state needs its own marker).
+_ANY = object()
+
+
+class CASConflict(RuntimeError):
+    """A conditional put/delete lost its precondition: the object's
+    current generation no longer matches what the caller read. Loud by
+    design — precondition loss means another writer won (a steal, a
+    concurrent commit) and blind retry would overwrite its work; callers
+    translate it into their protocol's loss path (``LeaseLost``, a lost
+    claim race, an idempotent re-read). Deliberately NOT an OSError:
+    the transient-error classifier must never auto-retry it."""
+
+
+def count(backend, op, outcome):
+    """One backend operation outcome into ``backend_ops_total`` — the
+    cross-backend cost/outcome headline (labels documented in README)."""
+    obs_inc("backend_ops_total", backend=backend, op=op, outcome=outcome)
+
+
+def _conflict(backend, path, op):
+    count(backend, op, "conflict")
+    obs_inc("backend_cas_conflicts_total")
+    raise CASConflict("{} precondition lost at {} ({})".format(
+        op, path, backend))
+
+
+def active_name():
+    """The selected backend name (``local`` unless the env var says
+    otherwise) — cheap enough for hot-path dispatch checks."""
+    return os.environ.get(ENV_VAR) or "local"
+
+
+_instances = {}
+_instances_lock = threading.Lock()
+
+
+def get_backend():
+    """The active backend instance (one per name per process)."""
+    name = active_name()
+    inst = _instances.get(name)
+    if inst is None:
+        with _instances_lock:
+            inst = _instances.get(name)
+            if inst is None:
+                if name == "local":
+                    inst = LocalBackend()
+                elif name == "mock":
+                    inst = MockObjectStore()
+                else:
+                    raise ValueError(
+                        "unknown storage backend {!r} (LDDL_TPU_STORAGE_"
+                        "BACKEND); expected one of {}".format(
+                            name, "/".join(BACKENDS)))
+                _instances[name] = inst
+    return inst
+
+
+def set_backend(name):
+    """Select the backend for this process AND future child processes
+    (env-var based, like ``faults.arm``)."""
+    if name not in BACKENDS:
+        raise ValueError("unknown storage backend {!r}; expected one of "
+                         "{}".format(name, "/".join(BACKENDS)))
+    os.environ[ENV_VAR] = name
+
+
+class LocalBackend(object):
+    """The POSIX shared-filesystem backend: delegates to the battle-tested
+    primitives in ``resilience/io``. ``is_cas`` is False — the lease
+    protocol keeps its atomic-rename + read-back shape here, because a
+    POSIX filesystem offers no conditional put (replace + read-back plus
+    the publish-time fence is the protocol *designed* for that medium).
+    ``put_if_match`` therefore supports only the create case (generation
+    None), which maps onto the same NFS-safe exclusive create the lease
+    acquire path uses."""
+
+    name = "local"
+    is_cas = False
+
+    def put_atomic(self, path, data):
+        from . import io as rio
+        rio.atomic_write(path, data)
+
+    def put_file(self, src, path):
+        from . import io as rio
+        rio.atomic_copy(src, path)
+
+    def put_if_match(self, path, data, expected_gen):
+        if expected_gen is not None:
+            raise NotImplementedError(
+                "LocalBackend has no conditional replace: POSIX offers no "
+                "CAS — the lease protocol uses atomic rename + read-back "
+                "plus publish-time fencing here by design")
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            _conflict(self.name, path, "cas-put")
+        try:
+            os.write(fd, data)
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        count(self.name, "cas-put", "ok")
+        return 1
+
+    def get(self, path, start=None, length=None):
+        from . import io as rio
+        data = rio.read_bytes(path)
+        if start is not None or length is not None:
+            lo = start or 0
+            data = data[lo:] if length is None else data[lo:lo + length]
+        return data
+
+    def get_versioned(self, path):
+        """(bytes, generation) of the current object, or (None, None)
+        when absent. POSIX files carry no generation; 0 stands in (the
+        local protocol never CAS-chains off it)."""
+        from . import io as rio
+        try:
+            return rio.read_bytes(path), 0
+        except FileNotFoundError:
+            return None, None
+
+    def list(self, dirpath):
+        try:
+            names = sorted(os.listdir(dirpath))
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+        count(self.name, "list", "ok")
+        return [n for n in names if ".tmp." not in n]
+
+    def delete(self, path):
+        try:
+            os.remove(path)
+        except FileNotFoundError:
+            pass
+        count(self.name, "delete", "ok")
+
+    def delete_if_match(self, path, expected_gen):
+        """Advisory on POSIX (no versions to compare): plain unlink. The
+        lease protocol's local release path does its own verified
+        unlink and never calls this."""
+        self.delete(path)
+        return True
+
+
+class MockObjectStore(object):
+    """In-process object store with object-store semantics (module
+    docstring has the layout). Thread-safe and multi-process-safe: all
+    coordination state is the exclusive-create commit records on disk, so
+    the 3-host chaos subprocesses race through it exactly like concurrent
+    clients race a real store."""
+
+    name = "mock"
+    is_cas = True
+
+    # Commit records of the newest two generations (and their parts) are
+    # kept; older ones are garbage-collected so renew-heavy lease objects
+    # don't grow without bound. Keeping one superseded generation lets an
+    # in-flight reader that already resolved it finish against intact
+    # parts (its NEXT read resolves the newer commit).
+    _KEEP_GENS = 2
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._upload_seq = 0
+        self._list_cache = {}
+        try:
+            self._part_bytes = int(os.environ.get(
+                "LDDL_TPU_MOCK_PART_BYTES", 1 << 18))
+        except ValueError:
+            self._part_bytes = 1 << 18
+        self._part_bytes = max(1, self._part_bytes)
+
+    # ------------------------------------------------------------ layout
+
+    @staticmethod
+    def _obj_dir(path):
+        d, b = os.path.split(os.path.abspath(path))
+        return os.path.join(d, OBJ_PREFIX + b)
+
+    @staticmethod
+    def _gen_name(gen):
+        return "g{:08d}.json".format(gen)
+
+    @classmethod
+    def _current_gen(cls, odir):
+        try:
+            names = sorted(os.listdir(odir))
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+        best = None
+        for n in names:
+            if n.startswith("g") and n.endswith(".json"):
+                try:
+                    g = int(n[1:-5])
+                except ValueError:
+                    continue
+                if best is None or g > best:
+                    best = g
+        return best
+
+    @staticmethod
+    def _read_meta(odir, gen):
+        with open(os.path.join(
+                odir, MockObjectStore._gen_name(gen)), "rb") as f:
+            return json.loads(f.read())
+
+    def _next_upload_id(self):
+        # pid + per-process sequence: unique across the racing hosts AND
+        # the writer thread vs main thread of one host. Identity of
+        # staging scratch only — committed object content never includes
+        # it (the commit record does, as provenance, and commit records
+        # are coordination state that no shard/manifest byte derives
+        # from).
+        with self._lock:
+            self._upload_seq += 1
+            return "{}-{}".format(os.getpid(), self._upload_seq)
+
+    def _chunks_of(self, data):
+        for off in range(0, len(data), self._part_bytes):
+            yield data[off:off + self._part_bytes]
+
+    # ------------------------------------------------------------- write
+
+    def _upload_parts(self, odir, chunks):
+        """Phase 1 of multipart-upload-then-commit: stream parts into the
+        object's staging namespace. A crash or injected fault here leaves
+        orphaned parts — an abandoned multipart upload, invisible to every
+        reader because no commit record references them."""
+        os.makedirs(odir, exist_ok=True)
+        uid = self._next_upload_id()
+        parts, total = [], 0
+        for k, chunk in enumerate(chunks):
+            pname = "u{}.p{:04d}".format(uid, k)
+            ppath = os.path.join(odir, pname)
+            faults.fault_point("open", ppath)
+            # Part staging, fsynced in full; promoted ONLY by the commit
+            # record below — a torn part is never referenced. (A
+            # zero-byte object is simply a commit record with no parts.)
+            with open(ppath, "wb") as f:
+                f.write(chunk)
+                f.flush()
+                os.fsync(f.fileno())
+            parts.append(pname)
+            total += len(chunk)
+        return uid, parts, total
+
+    def _commit(self, path, odir, uid, parts, size, expected_gen):
+        """Phase 2: linearize via exclusive create of the generation's
+        commit record. Exactly one writer per generation wins the
+        ``os.link``; everyone else gets a CAS conflict and their parts
+        stay behind as an abandoned upload."""
+        action = faults.fault_point("multipart-commit", path)
+        if action == "conflict":
+            _conflict(self.name, path, "multipart-commit")
+        cur = self._current_gen(odir)
+        if expected_gen is not _ANY and cur != expected_gen:
+            _conflict(self.name, path, "cas-put")
+        target = 1 if cur is None else cur + 1
+        meta = {"parts": parts, "size": size, "upload": uid}
+        tmp = os.path.join(odir, "commit.{}.tmp".format(uid))
+        # Commit-record staging, promoted only via the exclusive link.
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(meta, sort_keys=True).encode("utf-8"))
+            f.flush()
+            os.fsync(f.fileno())
+        gpath = os.path.join(odir, self._gen_name(target))
+        try:
+            try:
+                os.link(tmp, gpath)
+            except FileExistsError:
+                _conflict(self.name, path,
+                          "cas-put" if expected_gen is not _ANY else "put")
+            # Mounts without hard links: O_EXCL performs the same
+            # exclusive create (mirrors leases._try_create).
+            except OSError:
+                try:
+                    fd = os.open(gpath,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                except FileExistsError:
+                    _conflict(self.name, path,
+                              "cas-put" if expected_gen is not _ANY
+                              else "put")
+                try:
+                    with open(tmp, "rb") as f:
+                        os.write(fd, f.read())
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+        finally:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+        self._gc(odir, target)
+        self._materialize(path, odir, meta)
+        return target
+
+    def _gc(self, odir, newest):
+        """Drop commit records (and their parts) older than the kept
+        window. Races between concurrent collectors are benign: every
+        step tolerates already-gone files."""
+        keep = set()
+        try:
+            names = sorted(os.listdir(odir))
+        except (FileNotFoundError, NotADirectoryError):
+            return
+        gens = []
+        for n in names:
+            if n.startswith("g") and n.endswith(".json"):
+                try:
+                    gens.append(int(n[1:-5]))
+                except ValueError:
+                    continue
+        for g in gens:
+            if g > newest - self._KEEP_GENS:
+                try:
+                    keep.update(self._read_meta(odir, g)["parts"])
+                except (OSError, ValueError, KeyError):
+                    continue
+        for g in gens:
+            if g <= newest - self._KEEP_GENS:
+                try:
+                    meta = self._read_meta(odir, g)
+                except (OSError, ValueError):
+                    meta = {"parts": ()}
+                for pname in meta.get("parts", ()):
+                    if pname in keep:
+                        continue
+                    try:
+                        os.unlink(os.path.join(odir, pname))
+                    except OSError:
+                        pass
+                try:
+                    os.unlink(os.path.join(odir, self._gen_name(g)))
+                except OSError:
+                    pass
+
+    def _materialize(self, path, odir, meta):
+        """Mirror the committed object at its real POSIX path so the
+        unchanged data-plane readers (loader, balancer, raw existence
+        checks) keep working. Internal mirror maintenance, not part of
+        the store API — the API exposes no rename. The ``replace`` fault
+        site fires with the REAL path, so existing chaos specs keyed on
+        publish targets hit the same window here."""
+        uid = self._next_upload_id()
+        tmp = "{}.tmp.{}".format(path, uid)
+        with open(tmp, "wb") as f:
+            for pname in meta["parts"]:
+                with open(os.path.join(odir, pname), "rb") as pf:
+                    shutil.copyfileobj(pf, f)
+            f.flush()
+            os.fsync(f.fileno())
+        faults.fault_point("replace", path)
+        os.replace(tmp, path)
+        from . import io as rio
+        rio._fsync_dir(path)
+
+    def _put_once(self, path, chunks, expected_gen):
+        action = faults.fault_point("cas-put", path)
+        if action == "conflict":
+            _conflict(self.name, path, "cas-put")
+        odir = self._obj_dir(path)
+        uid, parts, size = self._upload_parts(odir, chunks)
+        return self._commit(path, odir, uid, parts, size, expected_gen)
+
+    def put_if_match(self, path, data, expected_gen):
+        """Conditional put: commit succeeds only while the object's
+        current generation equals ``expected_gen`` (None = must not
+        exist). Returns the new generation; raises :class:`CASConflict`
+        on precondition loss. The store's compare-and-swap — what the
+        lease protocol's acquire/renew/steal become here."""
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        gen = self._put_once(path, self._chunks_of(data), expected_gen)
+        count(self.name, "cas-put", "ok")
+        return gen
+
+    def _put_retry_races(self, path, chunks_fn):
+        """Unconditional last-writer-wins put: re-reads the current
+        generation and retries lost CAS races (bounded — sustained loss
+        against a determinism-pinned pipeline would mean a protocol bug,
+        so it eventually surfaces loudly)."""
+        last = None
+        for _ in range(32):
+            cur = self._current_gen(self._obj_dir(path))
+            try:
+                return self._put_once(
+                    path, chunks_fn(), cur if cur is not None else None)
+            except CASConflict as e:
+                last = e
+        raise OSError(
+            errno.EIO, "mock put of {} lost 32 consecutive CAS "
+            "races".format(path)) from last
+
+    def put_atomic(self, path, data):
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        self._put_retry_races(path, lambda: self._chunks_of(data))
+        count(self.name, "put", "ok")
+
+    def put_file(self, src, path):
+        """Multipart-upload-then-commit from a fully-written local
+        staging file (how shard publishes arrive: parquet bytes are
+        staged locally, then uploaded in parts)."""
+
+        def chunks():
+            with open(src, "rb") as f:
+                while True:
+                    c = f.read(self._part_bytes)
+                    if not c:
+                        return
+                    yield c
+
+        self._put_retry_races(path, chunks)
+        count(self.name, "put", "ok")
+
+    # -------------------------------------------------------------- read
+
+    def _read_committed(self, path, odir, gen, start=None, length=None):
+        meta = self._read_meta(odir, gen)
+        buf = []
+        for pname in meta["parts"]:
+            with open(os.path.join(odir, pname), "rb") as f:
+                buf.append(f.read())
+        data = b"".join(buf)
+        if start is not None or length is not None:
+            lo = start or 0
+            data = data[lo:] if length is None else data[lo:lo + length]
+        return data
+
+    def get(self, path, start=None, length=None):
+        """Read the newest committed generation (ranged when
+        ``start``/``length`` given — the ``range-read`` fault site).
+        Paths never written through the store (source corpora, spool
+        scratch) fall back to the plain file: they are external,
+        generation-less objects."""
+        faults.fault_point("open", path)
+        odir = self._obj_dir(path)
+        cur = self._current_gen(odir)
+        if cur is None:
+            if os.path.isfile(path):
+                with open(path, "rb") as f:
+                    if start:
+                        f.seek(start)
+                    data = f.read(-1 if length is None else length)
+            else:
+                raise FileNotFoundError(
+                    errno.ENOENT, "no such object", path)
+        else:
+            data = self._read_committed(path, odir, cur, start, length)
+        ranged = start is not None or length is not None
+        action = faults.fault_point(
+            "range-read" if ranged else "read", path)
+        if action == "truncate":
+            data = data[:max(0, len(data) // 2 - 1)]
+        count(self.name, "range-read" if ranged else "get", "ok")
+        return data
+
+    def get_versioned(self, path):
+        """(bytes, generation) of the current committed object, or
+        (None, None) when the path has never been committed — the read
+        half of every CAS chain. External plain files are NOT versioned
+        reads: the CAS namespace is store-managed objects only."""
+        faults.fault_point("open", path)
+        odir = self._obj_dir(path)
+        cur = self._current_gen(odir)
+        if cur is None:
+            return None, None
+        data = self._read_committed(path, odir, cur)
+        if faults.fault_point("read", path) == "truncate":
+            data = data[:max(0, len(data) // 2 - 1)]
+        count(self.name, "get", "ok")
+        return data, cur
+
+    def list(self, dirpath):
+        """Sorted names of the directory's objects: committed store
+        objects plus external plain files (hidden names and publish
+        scratch excluded). The ``list`` fault site's ``stale`` kind
+        serves the PREVIOUS snapshot this process took — a
+        list-after-put staleness window, which callers must (and do)
+        tolerate: listings are discovery hints, record reads are the
+        truth."""
+        try:
+            names = sorted(os.listdir(dirpath))
+        except (FileNotFoundError, NotADirectoryError):
+            return None
+        out = set()
+        for n in names:
+            if n.startswith(OBJ_PREFIX):
+                odir = os.path.join(dirpath, n)
+                if self._current_gen(odir) is not None:
+                    out.add(n[len(OBJ_PREFIX):])
+            elif n.startswith(".") or ".tmp." in n:
+                continue
+            else:
+                out.add(n)
+        result = sorted(out)
+        if faults.fault_point("list", dirpath) == "stale":
+            prev = self._list_cache.get(dirpath)
+            if prev is not None:
+                count(self.name, "list", "stale")
+                return list(prev)
+        self._list_cache[dirpath] = tuple(result)
+        count(self.name, "list", "ok")
+        return result
+
+    # ------------------------------------------------------------ delete
+
+    def delete(self, path):
+        """Unconditional delete: drop the commit records (authoritative)
+        then the materialized view. Immediately consistent in the mock —
+        real-store delete lag is modeled by the ``list`` staleness fault
+        instead, which is where the pipeline would feel it."""
+        odir = self._obj_dir(path)
+        shutil.rmtree(odir, ignore_errors=True)
+        try:
+            os.remove(path)
+        except (FileNotFoundError, IsADirectoryError):
+            pass
+        count(self.name, "delete", "ok")
+
+    def delete_if_match(self, path, expected_gen):
+        """Conditional delete (lease release): succeeds only while the
+        current generation matches. The check-then-delete pair is not
+        atomic — a writer landing in between loses its commit records;
+        acceptable here because the only conditional deleter is the
+        lease release path, whose worst case (dropping a clock-skewed
+        thief's lease) the protocol already tolerates on the local
+        path."""
+        cur = self._current_gen(self._obj_dir(path))
+        if cur != expected_gen:
+            _conflict(self.name, path, "delete")
+        self.delete(path)
+        return True

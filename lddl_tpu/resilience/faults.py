@@ -17,15 +17,18 @@ Spec grammar — comma-separated clauses of colon-separated fields::
 
     op    site name: open | read | replace | worker | lease-acquire |
           lease-renew | lease-release | journal-read | journal-publish |
-          sink-write (or * for any site)
-    kind  eio | estale | truncate | slow | stall | kill
+          sink-write | cas-put | range-read | multipart-commit | list
+          (or * for any site; the last four fire only on the mock
+          object-store backend — see resilience/backend.py)
+    kind  eio | estale | truncate | slow | stall | kill | conflict | stale
     p     per-call injection probability (seeded per process)
     nth   inject on exactly the Nth matching call of this process
     max   cap on injections per process (default: 1 for nth, unlimited for p)
     path  only calls whose path/tag contains this substring match
     delay sleep seconds for kind=slow (default 0.2) and kind=stall
-          (default 30; set it past the lease TTL at a lease-renew site to
-          freeze the renewal and force a steal)
+          (default 30; set it past the lease TTL at a lease-renew — or,
+          on the mock store, a cas-put — site to freeze the renewal and
+          force a steal)
     flag  cross-process once-latch: inject only while <file> does not
           exist, and create it upon injection (survives respawned workers)
 
@@ -40,6 +43,13 @@ Examples::
                                                    # cache -> segment rescan
     LDDL_TPU_FAULTS="sink-write:kill:nth=2"  # SIGKILL on the shard-writer
                                              # thread mid-deferred-publish
+
+Mock-object-store kinds: ``conflict`` (returned as an action at
+``cas-put`` / ``multipart-commit``; the store raises an injected
+``CASConflict`` — a lost precondition) and ``stale`` (returned at
+``list``; the store serves its previous listing snapshot — a
+list-after-put staleness window). Both are no-ops on the POSIX paths,
+which never ask for them.
 
 The ``sink-write`` site fires on the async shard-writer THREAD
 (preprocess/sink.py), immediately before each deferred publish closure
@@ -75,7 +85,8 @@ def _parse_clause(text, index):
         raise FaultSpecError(
             "fault clause {!r} needs at least <op>:<kind>".format(text))
     op, kind = fields[0].strip(), fields[1].strip()
-    if kind not in ("eio", "estale", "truncate", "slow", "stall", "kill"):
+    if kind not in ("eio", "estale", "truncate", "slow", "stall", "kill",
+                    "conflict", "stale"):
         raise FaultSpecError("unknown fault kind {!r} in {!r}".format(
             kind, text))
     clause = {"op": op, "kind": kind, "p": None, "nth": None, "max": None,
@@ -181,9 +192,12 @@ def _latch(clause, op):
 
 
 def fault_point(op, path=None):
-    """Guarded-operation hook. Returns None (no fault) or the string
-    ``"truncate"`` (the caller must truncate the bytes it read). Raises
-    OSError / sleeps / SIGKILLs the process for the other kinds."""
+    """Guarded-operation hook. Returns None (no fault) or an action
+    string the caller must honor — ``"truncate"`` (chop the bytes just
+    read), ``"conflict"`` (mock store: raise an injected CASConflict),
+    ``"stale"`` (mock store: serve the previous listing snapshot).
+    Raises OSError / sleeps / SIGKILLs the process for the other
+    kinds."""
     clauses = _refresh()  # one env-dict lookup when disarmed
     if not clauses:
         return None
@@ -215,9 +229,11 @@ def fault_point(op, path=None):
                 pass
             import signal
             os.kill(os.getpid(), signal.SIGKILL)
-        elif kind == "truncate":
+        elif kind in ("truncate", "conflict", "stale"):
+            # Action kinds: the caller interprets the string (chop the
+            # read bytes / raise CASConflict / serve a stale listing).
             _latch(clause, op)
-            action = "truncate"
+            action = kind
         else:
             _latch(clause, op)
             err = _ERRNO_OF[kind]

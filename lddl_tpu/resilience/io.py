@@ -16,6 +16,13 @@ This module is the single place the pipeline does either of:
 Every primitive calls ``faults.fault_point`` at its guarded operations, so
 the chaos harness can inject failures into real pipeline runs.
 
+These primitives are also the pipeline's storage-backend seam
+(``resilience/backend.py``): under the default LocalBackend every branch
+below is the pre-backend POSIX code verbatim (zero new syscalls — the
+dispatch check is one env-dict lookup); under ``LDDL_TPU_STORAGE_BACKEND=
+mock`` publishes become multipart-upload-then-commit against the mock
+object store and reads resolve through its versioned commit records.
+
 Env knobs (all optional)::
 
     LDDL_TPU_RETRY_ATTEMPTS      max attempts per operation (default 5)
@@ -30,6 +37,7 @@ import os
 import random
 import time
 
+from . import backend as _backend
 from . import faults
 from ..observability import event as obs_event
 from ..observability import fleet
@@ -65,6 +73,15 @@ def retry_policy():
         "base_delay_s": _env_float("LDDL_TPU_RETRY_BASE_DELAY_S", 0.05),
         "max_delay_s": _env_float("LDDL_TPU_RETRY_MAX_DELAY_S", 2.0),
     }
+
+
+def _mock_backend():
+    """The active non-POSIX backend, or None under the default
+    LocalBackend (whose hot path is the inline pre-backend code below,
+    not a dispatch — the check costs one env-dict lookup)."""
+    if (os.environ.get(_backend.ENV_VAR) or "local") == "local":
+        return None
+    return _backend.get_backend()
 
 
 _jitter_rng = random.Random()
@@ -127,26 +144,48 @@ def with_retries(fn, desc="operation", attempts=None, deadline_s=None,
 
 def _fsync_dir(path):
     """Flush a directory entry (the rename itself) to stable storage.
-    Best-effort: some filesystems (FAT, some FUSE mounts) refuse directory
-    fsync — a failure there must not undo a completed replace."""
+    Transient errors (flaky NFS/GCS-fuse EIO) retry through the
+    classifier like every neighboring durable-path op — previously a
+    single transient EIO silently SKIPPED the dir fsync, a durability
+    hole where the completed replace could evaporate on power loss.
+    Terminal refusals stay best-effort: some filesystems (FAT, some FUSE
+    mounts) refuse directory fsync, and a refusal must not undo a
+    completed replace."""
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+
+    def _sync():
+        fd = os.open(dirname, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
     try:
-        fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
-                     os.O_RDONLY)
-    except OSError:
-        return
-    try:
-        os.fsync(fd)
+        with_retries(_sync, desc="fsync dir {}".format(dirname))
+    # Deliberate best-effort swallow (see docstring): only non-transient
+    # refusals and exhausted transients land here, after the classifier
+    # has already retried everything retryable. -- lddl: disable=swallowed-error
     except OSError:
         pass
-    finally:
-        os.close(fd)
 
 
 def atomic_publish(tmp_path, path, fsync_file=True):
     """Atomically move a fully-written temp file into place: fsync the
     file's bytes, ``os.replace`` into the target name, fsync the directory
     so the rename itself is durable. The ONLY sanctioned publish primitive
-    (with atomic_write) for files in shard directories."""
+    (with atomic_write) for files in shard directories.
+
+    On the mock object store there is no rename: the temp's bytes are
+    published via multipart-upload-then-commit and the temp is consumed
+    (unlinked) to keep the caller contract identical."""
+    bk = _mock_backend()
+    if bk is not None:
+        bk.put_file(tmp_path, path)
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return
     if fsync_file:
         fd = os.open(tmp_path, os.O_RDONLY)
         try:
@@ -156,6 +195,7 @@ def atomic_publish(tmp_path, path, fsync_file=True):
     faults.fault_point("replace", path)
     os.replace(tmp_path, path)
     _fsync_dir(path)
+    _backend.count("local", "put", "ok")
 
 
 def atomic_write(path, data, retries=True):
@@ -203,6 +243,13 @@ def atomic_copy(src, path, retries=True):
     tmp = "{}.tmp.{}".format(path, os.getpid())
 
     def _copy():
+        bk = _mock_backend()
+        if bk is not None:
+            # No hard links on an object store: multipart-upload the
+            # source's bytes (src stays in place, same idempotence
+            # contract as the link path).
+            bk.put_file(src, path)
+            return
         faults.fault_point("open", path)
         try:
             try:
@@ -239,12 +286,18 @@ def read_bytes(path, retries=True):
     read on flaky storage)."""
 
     def _read():
+        bk = _mock_backend()
+        if bk is not None:
+            # The store fires its own open/read(/range-read) fault
+            # points and resolves the newest committed generation.
+            return bk.get(path)
         faults.fault_point("open", path)
         with open(path, "rb") as f:
             data = f.read()
         action = faults.fault_point("read", path)
         if action == "truncate":
             data = data[:max(0, len(data) // 2 - 1)]
+        _backend.count("local", "get", "ok")
         return data
 
     if retries:
@@ -274,7 +327,11 @@ def read_json(path, retries=True):
 def open_append(path, retries=True):
     """Open a spool file for append, retrying transient open errors.
     Only the OPEN retries: retrying a failed append could duplicate
-    bytes, so write errors propagate to the unit-level fault handling."""
+    bytes, so write errors propagate to the unit-level fault handling.
+    Spool appends stay POSIX on every backend — scatter spools are
+    holder-keyed local scratch, never objects (object stores have no
+    append); their bytes reach the store only via the publish
+    primitives above."""
 
     def _open():
         faults.fault_point("open", path)
@@ -333,3 +390,59 @@ def write_table_atomic(table, path, compression=None, retries=True,
     if retries:
         return with_retries(_write, desc="write parquet {}".format(path))
     return _write()
+
+
+def list_dir(path):
+    """Sorted directory listing through the active backend (publish
+    scratch excluded), or None when the directory is absent. On the mock
+    store this is the ``list`` fault site — an injected ``stale`` kind
+    serves a pre-put snapshot, which callers must treat as a discovery
+    hint, never as record truth."""
+    bk = _mock_backend()
+    if bk is not None:
+        return bk.list(path)
+    try:
+        names = sorted(os.listdir(path))
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    _backend.count("local", "list", "ok")
+    return [n for n in names if ".tmp." not in n]
+
+
+def remove(path):
+    """Delete one published record through the active backend (missing is
+    fine — removals race sweeps by design). On the mock store this drops
+    the authoritative commit records too: a raw ``os.remove`` there would
+    leave the object readable through the backend, silently resurrecting
+    a withdrawn record."""
+    bk = _mock_backend()
+    if bk is not None:
+        bk.delete(path)
+        return
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
+    _backend.count("local", "delete", "ok")
+
+
+def put_exclusive(path, data):
+    """Create-only publish: ``"ok"`` when this caller's bytes committed,
+    ``"conflict"`` when the object already exists (mock store CAS
+    create). On the local backend this is today's ``atomic_write`` —
+    the POSIX journal-commit contract is unchanged (single in-sequence
+    writer; the segment hole/torn checks stay the guard), while the mock
+    store upgrades the commit point to a real conditional create so a
+    raced commit surfaces as a conflict instead of a silent overwrite."""
+    bk = _mock_backend()
+    if bk is not None:
+        if isinstance(data, str):
+            data = data.encode("utf-8")
+        try:
+            with_retries(lambda: bk.put_if_match(path, data, None),
+                         desc="put_exclusive {}".format(path))
+        except _backend.CASConflict:
+            return "conflict"
+        return "ok"
+    atomic_write(path, data)
+    return "ok"

@@ -37,6 +37,19 @@ it is allowlisted for exactly that.
 Chaos sites: ``lease-acquire`` / ``lease-renew`` / ``lease-release`` fault
 points fire at the guarded operations; the ``stall`` fault kind freezes a
 renewal past the deadline to force a steal (see ``faults.py``).
+
+**CAS backends** (``LDDL_TPU_STORAGE_BACKEND=mock`` — resilience/
+backend.py): on a store with conditional put, acquire/renew/steal become
+compare-and-swap on the lease object's generation instead of replace +
+read-back — create is ``put_if_match(..., None)``, renew/steal are
+``put_if_match(..., gen_read)``, release is ``delete_if_match``. The
+fence stays a precondition check, so the exactly-once proof carries: a
+fenced loser's conditional put FAILS (``CASConflict`` → ``LeaseLost``)
+instead of a read-back mismatching after the fact, and concurrent
+stealers are perfectly serialized (exactly one conditional put per
+generation wins — strictly stronger than the replace race the local
+protocol tolerates by design). Epoch semantics, counters, fleet events,
+the deadline cache, and every fault site are identical across backends.
 """
 
 import json
@@ -48,6 +61,7 @@ import threading
 import time
 import uuid
 
+from . import backend as storage
 from . import faults
 from . import io as rio
 from ..observability import event as obs_event
@@ -87,17 +101,22 @@ class LeaseLost(RuntimeError):
 class Lease(object):
     """One held lease. ``lost`` is flipped by the keeper thread when a
     renewal discovers the lease was stolen; the claim loop checks it (and
-    re-verifies on disk) before publishing the unit."""
+    re-verifies on disk) before publishing the unit. ``gen`` is the lease
+    object's storage generation on CAS backends (None on the local
+    atomic-rename protocol): every conditional renew chains off the
+    generation the previous operation returned."""
 
-    __slots__ = ("root", "unit", "holder", "epoch", "deadline", "lost")
+    __slots__ = ("root", "unit", "holder", "epoch", "deadline", "lost",
+                 "gen")
 
-    def __init__(self, root, unit, holder, epoch, deadline):
+    def __init__(self, root, unit, holder, epoch, deadline, gen=None):
         self.root = root
         self.unit = unit
         self.holder = holder
         self.epoch = epoch
         self.deadline = deadline
         self.lost = False
+        self.gen = gen
 
     @property
     def path(self):
@@ -230,6 +249,121 @@ def _publish(path, rec, holder):
         _cleanup_tmp(tmp)
 
 
+# ------------------------------------------------ CAS-backend primitives
+
+def _cas_backend():
+    """The active CAS-capable storage backend, or None when the default
+    LocalBackend is active (the atomic-rename protocol below is the
+    local path — unchanged, byte for byte)."""
+    bk = storage.get_backend()
+    return bk if bk.is_cas else None
+
+
+def _read_lease_versioned(bk, root, unit):
+    """CAS read: ``(record, generation)`` for the unit's lease object, or
+    ``(None, None)`` when absent. Torn bytes map to the same expired
+    epoch-0 record as :func:`read_lease` — but keep their generation, so
+    the subsequent steal is still a conditional put."""
+    path = lease_path(root, unit)
+    _op("read")
+    data, gen = rio.with_retries(lambda: bk.get_versioned(path),
+                                 desc="lease get {}".format(path))
+    if data is None:
+        return None, None
+    try:
+        rec = json.loads(data)
+    except ValueError:
+        rec = None
+    if isinstance(rec, dict):
+        return rec, gen
+    _log.warning("torn/unparseable lease object %s; treating as expired",
+                 path)
+    obs_inc("lease_torn_reads_total")
+    return {"unit": unit, "holder": "", "epoch": 0, "deadline": 0.0,
+            "torn": True}, gen
+
+
+def _cas_put(bk, path, rec, expected_gen, kind):
+    """One conditional lease put (create when ``expected_gen`` is None).
+    Transient store errors retry through the classifier; a
+    :class:`backend.CASConflict` propagates — precondition loss is the
+    protocol signal, never a retry candidate."""
+    _op(kind)
+    data = json.dumps(rec, sort_keys=True).encode("utf-8")
+    return rio.with_retries(
+        lambda: bk.put_if_match(path, data, expected_gen),
+        desc="lease cas-put {}".format(path))
+
+
+def _try_acquire_cas(bk, root, unit, holder, ttl_s, now, held_cache,
+                     known_missing):
+    """CAS-backend claim: the same state machine as the local path below,
+    with conditional puts serializing what replace + read-back only
+    narrows. A conflict anywhere means another claimant won — count it
+    and stand down (the next pass re-reads)."""
+    path = lease_path(root, unit)
+    if known_missing:
+        cur, gen = None, None
+    else:
+        cur, gen = _read_lease_versioned(bk, root, unit)
+    if cur is None:
+        rec = _record(unit, holder, 0, now + ttl_s)
+        try:
+            g = _cas_put(bk, path, rec, None, "create")
+        except storage.CASConflict:
+            obs_inc("lease_acquire_conflicts_total")
+            return None
+        obs_inc("lease_acquires_total")
+        fleet.record("unit.claimed", unit=str(unit), epoch=0,
+                     holder=holder)
+        return Lease(root, unit, holder, 0, rec["deadline"], gen=g)
+    if float(cur.get("deadline", 0.0)) > now and not cur.get("torn"):
+        if held_cache is not None:
+            held_cache[unit] = float(cur.get("deadline", 0.0))
+        obs_inc("lease_acquire_conflicts_total")
+        return None
+    new_epoch = int(cur.get("epoch", 0)) + 1
+    rec = _record(unit, holder, new_epoch, now + ttl_s)
+    try:
+        g = _cas_put(bk, path, rec, gen, "publish")
+    except storage.CASConflict:
+        obs_inc("lease_acquire_conflicts_total")
+        return None
+    obs_inc("lease_acquires_total")
+    obs_inc("lease_steals_total")
+    obs_event("lease.steal", unit=str(unit), epoch=new_epoch,
+              prev_holder=str(cur.get("holder", "")))
+    fleet.record("unit.stolen", unit=str(unit), epoch=new_epoch,
+                 holder=holder, prev_holder=str(cur.get("holder", "")))
+    return Lease(root, unit, holder, new_epoch, rec["deadline"], gen=g)
+
+
+def _renew_cas(bk, lease, ttl_s, now_fn):
+    """CAS-backend renewal: read → fence-match → conditional put. No
+    read-back on any path — the conditional put IS the read-back: a
+    concurrent replace between our read and our put surfaces as
+    :class:`backend.CASConflict`, i.e. the fence tripping as a
+    precondition instead of after the fact."""
+    cur, gen = _read_lease_versioned(bk, lease.root, lease.unit)
+    if not _matches(cur, lease.holder, lease.epoch):
+        lease.lost = True
+        raise LeaseLost("lease for unit {} was stolen (now {})".format(
+            lease.unit, cur))
+    rec = _record(lease.unit, lease.holder, lease.epoch,
+                  now_fn() + ttl_s)
+    try:
+        lease.gen = _cas_put(bk, lease.path, rec, gen, "publish")
+    except storage.CASConflict:
+        lease.lost = True
+        raise LeaseLost("lease for unit {} lost during renewal "
+                        "(CAS precondition)".format(lease.unit))
+    lease.deadline = rec["deadline"]
+    obs_inc("lease_renews_total")
+    fleet.record("unit.renewed", unit=str(lease.unit), epoch=lease.epoch,
+                 holder=lease.holder)
+    return lease
+
+
 def scan_units(root):
     """One directory scan of the lease root: the set of unit keys that
     currently have a lease file (tmp debris excluded), or None when the
@@ -237,6 +371,12 @@ def scan_units(root):
     per-unit existence reads — the amortization both the batched keeper
     pass and the claim loop's per-pass snapshot ride."""
     _op("scan")
+    bk = _cas_backend()
+    if bk is not None:
+        names = bk.list(root)
+        if names is None:
+            return None
+        return {n[:-len(".json")] for n in names if n.endswith(".json")}
     try:
         names = sorted(os.listdir(root))
     except (FileNotFoundError, NotADirectoryError):
@@ -280,6 +420,10 @@ def try_acquire(root, unit, holder, ttl_s, now_fn=time.time,
     os.makedirs(root, exist_ok=True)
     path = lease_path(root, unit)
     faults.fault_point("lease-acquire", path)
+    bk = _cas_backend()
+    if bk is not None:
+        return _try_acquire_cas(bk, root, unit, holder, ttl_s, now,
+                                held_cache, known_missing)
     cur = None if known_missing else read_lease(root, unit)
     if cur is None:
         rec = _record(unit, holder, 0, now + ttl_s)
@@ -349,6 +493,9 @@ def renew(lease, ttl_s, now_fn=time.time):
     pass and a steal to land — exactly the scenario the fence exists for."""
     path = lease.path
     faults.fault_point("lease-renew", path)
+    bk = _cas_backend()
+    if bk is not None:
+        return _renew_cas(bk, lease, ttl_s, now_fn)
     cur = read_lease(lease.root, lease.unit)
     if not _matches(cur, lease.holder, lease.epoch):
         lease.lost = True
@@ -375,9 +522,14 @@ def renew_fast(lease, ttl_s, now_fn=time.time):
     read give the same guarantee one FS round trip cheaper, which is the
     point of the batched pass. Counters and fleet events are identical to
     :func:`renew`; the ``lease-renew`` fault site still fires first, so
-    the chaos suite's forced-stall steal scenario is unchanged."""
+    the chaos suite's forced-stall steal scenario is unchanged. On a CAS
+    backend renew and renew_fast are the same operation — the conditional
+    put already carries the read-back's guarantee for free."""
     path = lease.path
     faults.fault_point("lease-renew", path)
+    bk = _cas_backend()
+    if bk is not None:
+        return _renew_cas(bk, lease, ttl_s, now_fn)
     cur = read_lease(lease.root, lease.unit)
     if not _matches(cur, lease.holder, lease.epoch):
         lease.lost = True
@@ -481,6 +633,26 @@ def release(lease, now_fn=time.time):
     rest of ``_leases/`` at finalize."""
     faults.fault_point("lease-release", lease.path)
     if lease.lost:
+        return
+    bk = _cas_backend()
+    if bk is not None:
+        # Conditional delete chained off our last-known generation; a
+        # conflict means a keeper renewal advanced it concurrently —
+        # re-read once and retry, then give up (a leftover lease object
+        # is inert, same as a leftover lease file).
+        for _ in range(2):
+            cur, gen = _read_lease_versioned(bk, lease.root, lease.unit)
+            if not _matches(cur, lease.holder, lease.epoch):
+                return
+            _op("unlink")
+            try:
+                rio.with_retries(
+                    lambda g=gen: bk.delete_if_match(lease.path, g),
+                    desc="lease delete {}".format(lease.path))
+            except storage.CASConflict:
+                continue
+            obs_inc("lease_releases_total")
+            return
         return
     if not legacy_coordination() and now_fn() < lease.deadline:
         # An unexpired lease cannot have been validly stolen, so the

@@ -1,0 +1,524 @@
+"""Pluggable storage backend (lddl_tpu/resilience/backend.py): mock
+object-store semantics (versioned objects, CAS, multipart-upload-then-
+commit, fault program), the CAS lease protocol, journal exactly-once
+commits, and local-vs-mock byte identity — the fast in-process half of
+the chaos proof (the 3-host SIGKILL matrix on the mock store lives in
+tests/test_chaos.py, -m slow).
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import threading
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu import observability as obs  # noqa: E402
+from lddl_tpu.resilience import backend as storage  # noqa: E402
+from lddl_tpu.resilience import faults  # noqa: E402
+from lddl_tpu.resilience import io as rio  # noqa: E402
+from lddl_tpu.resilience import leases  # noqa: E402
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _fast_retries(monkeypatch):
+    monkeypatch.setenv("LDDL_TPU_RETRY_BASE_DELAY_S", "0.001")
+    monkeypatch.setenv("LDDL_TPU_RETRY_MAX_DELAY_S", "0.01")
+
+
+@pytest.fixture
+def mock_bk(monkeypatch):
+    """The mock store selected for this test (env-scoped, like a spawned
+    worker would inherit it)."""
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    return storage.get_backend()
+
+
+def _metrics(monkeypatch, tmp_path):
+    monkeypatch.setenv("LDDL_TPU_METRICS_DIR", str(tmp_path / "metrics"))
+    obs.registry().reset()
+    return obs.registry()
+
+
+# ------------------------------------------------- mock store semantics
+
+
+def test_mock_put_get_roundtrip_versions_and_view(mock_bk, tmp_path):
+    p = str(tmp_path / "rec.json")
+    mock_bk.put_atomic(p, b"v1")
+    assert mock_bk.get(p) == b"v1"
+    assert mock_bk.get_versioned(p) == (b"v1", 1)
+    mock_bk.put_atomic(p, b"v2-longer")
+    assert mock_bk.get_versioned(p) == (b"v2-longer", 2)
+    # The materialized view keeps unchanged data-plane readers working
+    # (plain open, no backend dispatch) ...
+    with open(p, "rb") as f:
+        assert f.read() == b"v2-longer"
+    # ... while the commit records stay authoritative in the sidecar.
+    odir = str(tmp_path / (storage.OBJ_PREFIX + "rec.json"))
+    assert os.path.isdir(odir)
+    assert mock_bk._current_gen(odir) == 2
+
+
+def test_mock_cas_create_and_conditional_replace(mock_bk, tmp_path):
+    p = str(tmp_path / "lease.json")
+    assert mock_bk.put_if_match(p, b"a", None) == 1
+    with pytest.raises(storage.CASConflict):
+        mock_bk.put_if_match(p, b"b", None)  # create: already exists
+    assert mock_bk.put_if_match(p, b"b", 1) == 2
+    with pytest.raises(storage.CASConflict):
+        mock_bk.put_if_match(p, b"c", 1)  # stale generation
+    assert mock_bk.get_versioned(p) == (b"b", 2)
+
+
+def test_mock_ranged_get_and_range_read_fault(mock_bk, tmp_path):
+    p = str(tmp_path / "blob")
+    mock_bk.put_atomic(p, b"0123456789")
+    assert mock_bk.get(p, start=3) == b"3456789"
+    assert mock_bk.get(p, start=3, length=4) == b"3456"
+    assert mock_bk.get(p, length=2) == b"01"
+    faults.arm("range-read:truncate:nth=1")
+    torn = mock_bk.get(p, start=0, length=10)
+    assert torn == b"0123"  # chopped mid-range: the torn-read shape
+    faults.disarm()
+    assert mock_bk.get(p, start=0, length=10) == b"0123456789"
+
+
+def test_mock_multipart_parts_torn_upload_and_retry(tmp_path, monkeypatch):
+    """A put larger than the part size uploads multiple parts; a fault at
+    the commit leaves an ABANDONED multipart upload (orphan parts, no
+    commit record, object invisible) and a retried put publishes clean —
+    the torn-multipart crash shape the chaos matrix replays."""
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    monkeypatch.setenv("LDDL_TPU_MOCK_PART_BYTES", "4")
+    bk = storage.MockObjectStore()  # fresh instance: part size is ctor-read
+    p = str(tmp_path / "shard.bin")
+    odir = bk._obj_dir(p)
+    data = b"abcdefghij" * 3  # 30 bytes -> 8 parts of <=4
+    faults.arm("multipart-commit:eio:nth=1")
+    with pytest.raises(OSError):
+        bk.put_if_match(p, data, None)
+    faults.disarm()
+    orphans = [n for n in os.listdir(odir) if ".p" in n]
+    assert len(orphans) == 8  # parts staged, never referenced
+    assert bk.get_versioned(p) == (None, None)  # invisible to readers
+    with pytest.raises(FileNotFoundError):
+        bk.get(p)
+    assert bk.put_if_match(p, data, None) == 1  # retry publishes clean
+    assert bk.get(p) == data
+    meta = bk._read_meta(odir, 1)
+    assert len(meta["parts"]) == 8
+    assert not set(meta["parts"]) & set(orphans)  # orphans unreferenced
+
+
+def test_mock_injected_cas_conflict_counts(mock_bk, tmp_path, monkeypatch):
+    reg = _metrics(monkeypatch, tmp_path)
+    p = str(tmp_path / "x.json")
+    faults.arm("cas-put:conflict:nth=1")
+    with pytest.raises(storage.CASConflict):
+        mock_bk.put_if_match(p, b"a", None)
+    faults.disarm()
+    assert reg.counter("backend_cas_conflicts_total").total() >= 1
+    # Unconditional puts HEAL injected conflicts (last-writer-wins
+    # retries the race) — only conditional ops surface them.
+    faults.arm("cas-put:conflict:nth=1")
+    mock_bk.put_atomic(p, b"b")
+    faults.disarm()
+    assert mock_bk.get(p) == b"b"
+
+
+def test_mock_stale_list_serves_previous_snapshot(mock_bk, tmp_path):
+    d = str(tmp_path / "ledger")
+    os.makedirs(d)
+    mock_bk.put_atomic(os.path.join(d, "a.json"), b"{}")
+    assert mock_bk.list(d) == ["a.json"]  # snapshot cached
+    mock_bk.put_atomic(os.path.join(d, "b.json"), b"{}")
+    faults.arm("list:stale:nth=1")
+    assert mock_bk.list(d) == ["a.json"]  # list-after-put staleness
+    faults.disarm()
+    assert mock_bk.list(d) == ["a.json", "b.json"]
+
+
+def test_mock_list_merges_objects_and_external_files(mock_bk, tmp_path):
+    d = str(tmp_path / "mixed")
+    os.makedirs(d)
+    mock_bk.put_atomic(os.path.join(d, "obj.json"), b"{}")
+    with open(os.path.join(d, "plain.txt"), "w") as f:
+        f.write("x")
+    with open(os.path.join(d, "x.tmp.123"), "w") as f:
+        f.write("scratch")
+    assert mock_bk.list(d) == ["obj.json", "plain.txt"]
+    assert mock_bk.list(str(tmp_path / "absent")) is None
+
+
+def test_mock_delete_and_conditional_delete(mock_bk, tmp_path):
+    p = str(tmp_path / "l.json")
+    gen = mock_bk.put_if_match(p, b"a", None)
+    with pytest.raises(storage.CASConflict):
+        mock_bk.delete_if_match(p, gen + 1)
+    assert mock_bk.get_versioned(p)[0] == b"a"  # survived the refused delete
+    assert mock_bk.delete_if_match(p, gen)
+    assert mock_bk.get_versioned(p) == (None, None)
+    assert not os.path.exists(p)  # view gone too
+    mock_bk.delete(p)  # deleting the deleted: fine
+
+
+def test_mock_gc_bounds_generations(mock_bk, tmp_path):
+    p = str(tmp_path / "renewed.json")
+    for i in range(10):
+        mock_bk.put_atomic(p, b"rec-%d" % i)
+    odir = mock_bk._obj_dir(p)
+    gens = [n for n in os.listdir(odir)
+            if n.startswith("g") and n.endswith(".json")]
+    assert len(gens) <= mock_bk._KEEP_GENS  # renew-heavy objects stay small
+    assert mock_bk.get_versioned(p) == (b"rec-9", 10)
+
+
+def test_local_backend_interface_parity(tmp_path):
+    """LocalBackend implements the same surface with POSIX semantics:
+    create-only CAS, generation-less reads, advisory conditional
+    delete."""
+    bk = storage.LocalBackend()
+    assert not bk.is_cas
+    p = str(tmp_path / "r.json")
+    bk.put_atomic(p, b"v1")
+    assert bk.get(p) == b"v1"
+    assert bk.get(p, start=1, length=1) == b"1"
+    assert bk.get_versioned(p) == (b"v1", 0)
+    assert bk.get_versioned(str(tmp_path / "absent")) == (None, None)
+    with pytest.raises(storage.CASConflict):
+        bk.put_if_match(p, b"x", None)  # exists: create refused
+    with pytest.raises(NotImplementedError):
+        bk.put_if_match(p, b"x", 1)  # POSIX has no conditional replace
+    q = str(tmp_path / "new.json")
+    assert bk.put_if_match(q, b"made", None) == 1
+    with open(q, "rb") as f:
+        assert f.read() == b"made"
+    assert bk.list(str(tmp_path)) == ["new.json", "r.json"]
+    assert bk.list(str(tmp_path / "absent")) is None
+    bk.delete(q)
+    bk.delete(q)  # idempotent
+    assert bk.delete_if_match(p, 0)
+    assert not os.path.exists(p)
+
+
+def test_backend_selection_env_and_flag(monkeypatch):
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    assert storage.active_name() == "local"
+    assert storage.get_backend().name == "local"
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    assert storage.get_backend().name == "mock"
+    with pytest.raises(ValueError):
+        storage.set_backend("s3")  # not wired: refuse loudly
+    # The CLI flag is sugar for the env var (so spawned workers inherit).
+    import argparse
+    from lddl_tpu.cli.common import apply_storage_backend, attach_storage_arg
+    ap = argparse.ArgumentParser()
+    attach_storage_arg(ap)
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    apply_storage_backend(ap.parse_args([]))
+    assert storage.ENV_VAR not in os.environ  # default: env untouched
+    apply_storage_backend(ap.parse_args(["--storage-backend", "mock"]))
+    assert os.environ[storage.ENV_VAR] == "mock"
+
+
+# --------------------------------------------------- CAS lease protocol
+
+
+def test_lease_cas_acquire_renew_steal_release(mock_bk, tmp_path):
+    root = str(tmp_path / "_leases")
+    os.makedirs(root)
+    now = [1000.0]
+
+    def clock():
+        return now[0]
+
+    a = leases.try_acquire(root, "u1", "hostA", 10.0, now_fn=clock)
+    assert a is not None and a.epoch == 0 and a.gen == 1
+    # Valid lease: a second claimant stands down.
+    assert leases.try_acquire(root, "u1", "hostB", 10.0,
+                              now_fn=clock) is None
+    # Renewal advances deadline AND generation (conditional put).
+    leases.renew(a, 10.0, now_fn=clock)
+    assert a.gen == 2
+    leases.renew_fast(a, 10.0, now_fn=clock)
+    assert a.gen == 3
+    assert leases.verify(a)
+    assert leases.scan_units(root) == {"u1"}
+    # Expiry: the steal is a conditional put at epoch+1.
+    now[0] += 20.0
+    b = leases.try_acquire(root, "u1", "hostB", 10.0, now_fn=clock)
+    assert b is not None and b.epoch == 1
+    # The loser's next renewal trips the CAS precondition, not a timer.
+    with pytest.raises(leases.LeaseLost):
+        leases.renew_fast(a, 10.0, now_fn=clock)
+    assert a.lost and not leases.verify(a)
+    leases.release(b, now_fn=clock)
+    assert leases.scan_units(root) == set()
+
+
+def test_lease_cas_create_race_loses_cleanly(mock_bk, tmp_path):
+    root = str(tmp_path / "_leases")
+    os.makedirs(root)
+    faults.arm("cas-put:conflict:nth=1")
+    assert leases.try_acquire(root, "u1", "hostA", 10.0) is None
+    faults.disarm()
+    got = leases.try_acquire(root, "u1", "hostA", 10.0)
+    assert got is not None and got.epoch == 0
+
+
+def test_stall_at_cas_put_forces_mock_store_steal(mock_bk, tmp_path,
+                                                 monkeypatch):
+    """The chaos shape CAS fencing exists for: holder A's renewal stalls
+    at the conditional put past the TTL, B steals, and A's put — now
+    against a superseded generation — loses the CAS instead of
+    overwriting B's lease (on the local path this window is closed
+    after-the-fact by the publish fence; here it never opens)."""
+    reg = _metrics(monkeypatch, tmp_path)
+    root = str(tmp_path / "_leases")
+    os.makedirs(root)
+    a = leases.try_acquire(root, "u1", "hostA", 0.6)
+    assert a is not None
+    # The flag latch file is written the instant the stall FIRES (before
+    # its sleep), so the main thread can wait until A is provably parked
+    # mid-put before stealing — no schedule luck.
+    flag = str(tmp_path / "stall.flag")
+    faults.arm("cas-put:stall:nth=1:delay=1.5:flag={}".format(flag))
+    outcome = {}
+
+    def renew_a():
+        try:
+            leases.renew(a, 0.6)
+        except leases.LeaseLost as e:
+            outcome["lost"] = e
+
+    t = threading.Thread(target=renew_a)
+    t.start()
+    while not os.path.exists(flag):
+        pass
+    # A is parked at its conditional put and its TTL is behind us from
+    # B's clock: steal. B's own cas-put sees no fault (nth=1 consumed).
+    deadline = a.deadline
+    b = leases.try_acquire(root, "u1", "hostB", 10.0,
+                           now_fn=lambda: deadline + 0.05)
+    assert b is not None and b.epoch == 1
+    t.join(timeout=30.0)
+    assert not t.is_alive()
+    assert isinstance(outcome.get("lost"), leases.LeaseLost)
+    assert a.lost
+    assert leases.verify(b)  # the thief's lease survived intact
+    assert reg.counter("backend_cas_conflicts_total").total() >= 1
+    leases.release(b)
+
+
+# ----------------------------------------- journal exactly-once commits
+
+
+def test_put_exclusive_semantics(mock_bk, tmp_path):
+    p = str(tmp_path / "seg.json")
+    assert rio.put_exclusive(p, '{"a": 1}') == "ok"
+    assert rio.put_exclusive(p, '{"a": 2}') == "conflict"
+    assert mock_bk.get(p) == b'{"a": 1}'  # loser never overwrote
+
+
+def test_put_exclusive_local_matches_pre_backend(tmp_path, monkeypatch):
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    p = str(tmp_path / "seg.json")
+    assert rio.put_exclusive(p, "data") == "ok"
+    with open(p) as f:
+        assert f.read() == "data"
+
+
+def test_journal_exclusive_commit_idempotent_vs_conflicting(
+        mock_bk, tmp_path, monkeypatch):
+    from lddl_tpu.ingest import journal as journal_mod
+    reg = _metrics(monkeypatch, tmp_path)
+    p = str(tmp_path / ".ingest" / "journal" / "gen-0000.json")
+    payload = {"generation": 0, "hashes": ["h1", "h2"]}
+    journal_mod.publish_record(p, payload, exclusive=True)
+    # A raced duplicate commit of IDENTICAL content is absorbed
+    # idempotently (redo after a crash-after-commit) ...
+    journal_mod.publish_record(p, payload, exclusive=True)
+    assert reg.counter(
+        "ingest_journal_idempotent_commits_total").total() == 1
+    # ... while different content for the same generation refuses loudly.
+    with pytest.raises(ValueError, match="DIFFERENT content"):
+        journal_mod.publish_record(p, {"generation": 0, "hashes": ["x"]},
+                                   exclusive=True)
+    assert json.loads(mock_bk.get(p)) == payload
+
+
+# -------------------------------------------- local-vs-mock byte identity
+
+
+@pytest.fixture(scope="module")
+def fixture_dirs(tmp_path_factory):
+    td = tmp_path_factory.mktemp("backend")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    return str(td), corpus, vocab
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    with open(gs.GOLDEN_FILE) as f:
+        return json.load(f)
+
+
+def test_mock_preprocess_matches_pinned_goldens(fixture_dirs, goldens,
+                                                tmp_path, monkeypatch):
+    """The whole preprocess pipeline on the mock store produces the
+    PINNED golden bytes — the backend is publish plumbing and must never
+    reach shard content (no golden regeneration: these are the seed's
+    own hashes)."""
+    td, corpus, vocab = fixture_dirs
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    out = str(tmp_path / "out")
+    assert gs.run_case(corpus, vocab, out, binned=True) \
+        == goldens["binned_masked"]
+    # Vacuity guard: the run really went through the object store.
+    sidecars = [n for n in os.listdir(out)
+                if n.startswith(storage.OBJ_PREFIX)]
+    assert sidecars, "no .obj.* sidecars: mock store was never exercised"
+
+
+def test_elastic_on_mock_store_matches_goldens(fixture_dirs, goldens,
+                                               tmp_path, monkeypatch):
+    """One elastic host coordinating through CAS leases on the mock
+    store == the pinned static bytes (lease protocol never reaches shard
+    content on ANY backend)."""
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    from lddl_tpu.preprocess.runner import (BertBucketProcessor,
+                                            run_sharded_pipeline)
+    td, corpus, vocab = fixture_dirs
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    out = str(tmp_path / "out")
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=True,
+                             schema_version=1)
+    proc = BertBucketProcessor(tok, cfg, 4242, out, 8, "parquet")
+    written = run_sharded_pipeline(
+        {"wikipedia": corpus}, out, proc, elastic=True, lease_ttl=5.0,
+        holder_id="solo-mock", num_blocks=12, sample_ratio=0.9, seed=4242,
+        global_shuffle=True, progress_interval=0.0)
+    assert written and sum(written.values()) > 0
+    assert gs.hash_outputs(out) == goldens["binned_masked"]
+    # Scheduling state fully cleaned up on the mock store too.
+    assert not os.path.isdir(os.path.join(out, "_leases"))
+    assert not os.path.isdir(os.path.join(out, "_done"))
+
+
+# --------------------------------------- ingest crash matrix (in-process)
+
+
+def _tree_hashes(root):
+    """sha256 of every visible published file, keyed by relpath. Mock
+    sidecars (.obj.*) and telemetry are backend implementation detail,
+    excluded from the identity claim."""
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if not d.startswith((storage.OBJ_PREFIX,
+                                                  ".telemetry")))
+        for name in sorted(filenames):
+            if ".tmp." in name:
+                continue
+            path = os.path.join(dirpath, name)
+            with open(path, "rb") as f:
+                out[os.path.relpath(path, root)] = hashlib.sha256(
+                    f.read()).hexdigest()
+    return out
+
+
+def test_ingest_crash_matrix_on_mock_matches_local(fixture_dirs, tmp_path,
+                                                   monkeypatch):
+    """The ingest acceptance pin, replayed on the object store: a mock-
+    store incremental directory that crashed at the intake publish,
+    crashed at the generation commit, absorbed a torn multipart upload
+    and an injected CAS conflict, and ran a round under REVERSED
+    filesystem enumeration ends byte-identical — shards, manifests,
+    journal segments — to a clean LocalBackend replay of the same
+    sequence, with every generation journaled exactly once."""
+    from lddl_tpu.ingest import ingest_once
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+    td, corpus, vocab = fixture_dirs
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=False)
+    KW = dict(num_shards=4, seed=7)
+
+    def landing(base, n_files, name):
+        d = os.path.join(base, name, "source")
+        os.makedirs(d, exist_ok=True)
+        for i in range(n_files):
+            shutil.copy(os.path.join(corpus, "source",
+                                     "{}.txt".format(i)),
+                        os.path.join(d, "{}.txt".format(i)))
+        return os.path.join(base, name)
+
+    base = str(tmp_path)
+    clean = str(tmp_path / "clean")
+    dirty = str(tmp_path / "dirty")
+
+    # Reference: clean two-round replay on the default LocalBackend.
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    for n in (1, 2):
+        ingest_once(clean, tok, landing=landing(base, n, "l-clean"),
+                    config=cfg, **KW)
+
+    # Dirty: the same sequence on the mock store, crashing along the way.
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    # Round 1: die at the intake publish (before any work), then resume.
+    faults.arm("journal-publish:eio:nth=1:path=intake")
+    with pytest.raises(OSError):
+        ingest_once(dirty, tok, landing=landing(base, 1, "l-dirty"),
+                    config=cfg, **KW)
+    faults.disarm()
+    ingest_once(dirty, tok, landing=landing(base, 1, "l-dirty"),
+                config=cfg, **KW)
+    # Round 2: one torn multipart upload (commit dies once, orphan parts
+    # left behind; the retry classifier republishes) plus one injected
+    # CAS conflict on a shard put (healed by last-writer-wins retry),
+    # then die at the generation commit and resume with filesystem
+    # enumeration REVERSED end to end.
+    faults.arm("multipart-commit:eio:nth=1:path=part,"
+               "cas-put:conflict:nth=1:path=part,"
+               "journal-publish:eio:nth=1:path=journal/gen-0001")
+    with pytest.raises(OSError):
+        ingest_once(dirty, tok, landing=landing(base, 2, "l-dirty"),
+                    config=cfg, **KW)
+    faults.disarm()
+    real_walk, real_listdir = os.walk, os.listdir
+
+    def reversed_walk(top, **kwargs):
+        for dirpath, dirnames, filenames in real_walk(top, **kwargs):
+            rd = list(reversed(sorted(dirnames)))
+            yield dirpath, rd, list(reversed(sorted(filenames)))
+            dirnames[:] = rd
+
+    monkeypatch.setattr(os, "walk", reversed_walk)
+    monkeypatch.setattr(
+        os, "listdir",
+        lambda p=".": list(reversed(sorted(real_listdir(p)))))
+    ingest_once(dirty, tok, landing=landing(base, 2, "l-dirty"),
+                config=cfg, **KW)
+    monkeypatch.undo()
+
+    assert _tree_hashes(dirty) == _tree_hashes(clean)
+    # Exactly-once journaling: one segment per generation, no holes.
+    segs = sorted(os.listdir(os.path.join(dirty, ".ingest", "journal")))
+    assert [s for s in segs if s.startswith("gen-")] \
+        == ["gen-0000.json", "gen-0001.json"]

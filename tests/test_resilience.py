@@ -139,6 +139,97 @@ def test_is_transient_classification():
     assert not rio.is_transient(ValueError("x"))
 
 
+def test_with_retries_deadline_raises_original_derived_error():
+    """Deadline expiry is not a bare timeout: the raised OSError carries
+    the last underlying error's errno/filename and chains from it."""
+    def always():
+        raise OSError(errno.EIO, "mount flapping", "/srv/x")
+
+    with pytest.raises(OSError, match="frob failed after 1 attempt") as ei:
+        rio.with_retries(always, desc="frob", attempts=99, deadline_s=0.0)
+    assert ei.value.errno == errno.EIO
+    assert ei.value.filename == "/srv/x"
+    assert isinstance(ei.value.__cause__, OSError)
+    assert "mount flapping" in str(ei.value.__cause__)
+
+
+def test_with_retries_jitter_stays_in_documented_bounds(monkeypatch):
+    """Backoff delay is base * 2^(attempt-1) scaled by uniform jitter in
+    [0.5, 1.5] — the bounds the module documents (unkeyed on purpose, so
+    retry storms desynchronize across ranks)."""
+    slept = []
+    monkeypatch.setattr(rio.time, "sleep", slept.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise OSError(errno.EIO, "flaky")
+        return "ok"
+
+    base = 0.1
+    assert rio.with_retries(flaky, desc="t", attempts=10, deadline_s=3600,
+                            base_delay_s=base, max_delay_s=60.0) == "ok"
+    assert len(slept) == 3
+    for k, delay in enumerate(slept):
+        nominal = base * (2 ** k)
+        assert 0.5 * nominal <= delay <= 1.5 * nominal, (k, delay)
+
+
+def test_with_retries_attempts_one_means_exactly_one_call():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError(errno.EIO, "x")
+
+    with pytest.raises(OSError, match="after 1 attempt"):
+        rio.with_retries(always, desc="t", attempts=1)
+    assert len(calls) == 1
+
+
+def test_fsync_dir_retries_transient_then_succeeds(tmp_path, monkeypatch):
+    """A single transient EIO no longer silently skips the directory
+    fsync (the durability hole): the fsync retries through the
+    classifier and completes."""
+    calls = []
+    real_fsync = os.fsync
+
+    def flaky_fsync(fd):
+        calls.append(1)
+        if len(calls) == 1:
+            raise OSError(errno.EIO, "flaky dir fsync")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(rio.os, "fsync", flaky_fsync)
+    rio._fsync_dir(str(tmp_path / "some-file"))
+    assert len(calls) == 2  # retried once, then durably synced
+
+
+def test_fsync_dir_swallows_terminal_refusal(tmp_path, monkeypatch):
+    """Non-transient refusals (FAT/FUSE EINVAL) stay best-effort: no
+    retry storm, no exception undoing a completed replace."""
+    calls = []
+
+    def refuse(fd):
+        calls.append(1)
+        raise OSError(errno.EINVAL, "fsync not supported on directory")
+
+    monkeypatch.setattr(rio.os, "fsync", refuse)
+    rio._fsync_dir(str(tmp_path / "some-file"))  # must not raise
+    assert len(calls) == 1  # EINVAL is not transient: no retries
+
+
+def test_open_append_retries_transient_open(tmp_path):
+    faults.arm("open:eio:nth=1:path=spool-a")
+    f = rio.open_append(str(tmp_path / "spool-a"))
+    try:
+        f.write(b"x")
+    finally:
+        f.close()
+    assert (tmp_path / "spool-a").read_bytes() == b"x"
+
+
 # ------------------------------------------------------------ atomic I/O
 
 

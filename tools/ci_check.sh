@@ -78,6 +78,17 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
         echo "ci_check: elastic smoke FAILED — legacy/batched divergence or crash" >&2
         exit 1
     fi
+    # Storage-backend smoke: the same preprocess -> balance -> load
+    # round trip on the default LocalBackend vs the MockObjectStore
+    # (--storage-backend mock). Byte identity is GATING — the backend
+    # is publish/coordination plumbing and must never reach shard
+    # bytes; the wall times it prints are informational.
+    if JAX_PLATFORMS=cpu python benchmarks/backend_smoke.py; then
+        echo "ci_check: storage-backend local-vs-mock smoke OK (walls non-gating)"
+    else
+        echo "ci_check: backend smoke FAILED — local/mock divergence or crash" >&2
+        exit 1
+    fi
 fi
 
 # Opt-in native-engine smoke: builds the C++ engine from source and runs

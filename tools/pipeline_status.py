@@ -146,9 +146,14 @@ def format_report(report):
 
 def run_once(args):
     from lddl_tpu.observability import fleet
+    from lddl_tpu.resilience import backend as storage
 
     report = fleet.aggregate(args.dir, stall_ttl=args.stall_ttl,
                              wedge_window=args.wedge_window)
+    # The backend this process would coordinate through (env-selected;
+    # chaos/CI runs export LDDL_TPU_STORAGE_BACKEND into the whole
+    # fleet, so the operator's status probe names the same store).
+    report["storage_backend"] = storage.active_name()
     if args.merge_trace:
         events, lanes = fleet.merge_traces(args.dir)
         with open(args.merge_trace, "w", encoding="utf-8") as f:
