@@ -176,6 +176,12 @@ def _telemetry_report(obs):
     bins = reg.get("loader_bin_choice_total")
     if bins is not None:
         print("telemetry: bin choices {}".format(bins.snapshot()["values"]))
+    # Critical-path attribution: where did the batch wall go, and is the
+    # step input-bound? (snapshot() also publishes the verdict gauges so
+    # the fleet rollup carries them.)
+    report = obs.attribution.snapshot()
+    if report is not None:
+        print(obs.attribution.format_report(report, indent="telemetry: "))
     obs.export_prom()
     obs.export_jsonl()
     path = obs.write_summary()

@@ -1,0 +1,165 @@
+"""CI smoke for the diagnosis surface: a tiny fleet-armed preprocess ->
+balance -> load run, then ``tools/pipeline_status.py`` driven the way an
+operator (or CI gate) would drive it.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_BENCH=1``.
+GATING — this is a correctness alarm for the observability pipeline,
+not a performance number:
+
+- ``pipeline_status --json --window`` must parse, report windowed rates
+  from the series segments, and carry the loader bound-verdict
+  attribution block (the loader leg really iterated batches);
+- a deliberately-tripped alert rule must force exit code 2, and the
+  relaxed rules file must then exit 0 with the resolve journaled.
+
+Prints one JSON line with what it found.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+_LOADER_DRIVER = """
+import os, sys, time
+data, vocab = sys.argv[1], sys.argv[2]
+os.environ["LDDL_TPU_FLEET_DIR"] = data
+os.environ["LDDL_TPU_FLEET_HOLDER"] = "loaderhost"
+os.environ["LDDL_TPU_FLEET_INTERVAL_S"] = "0.2"
+from lddl_tpu.loader import get_bert_pretrain_data_loader
+loader = get_bert_pretrain_data_loader(
+    data, vocab_file=vocab, batch_size=8, num_workers=0)
+n = 0
+for batch in loader:
+    time.sleep(0.002)  # a (tiny) consumer step, so step_gap is real
+    n += 1
+print("BATCHES", n)
+"""
+
+
+def _status(data, *extra):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.pipeline_status", data, "--json"]
+        + list(extra),
+        capture_output=True, text=True, cwd=ROOT)
+    try:
+        doc = json.loads(proc.stdout)
+    except ValueError:
+        print("status smoke: --json did not parse (rc={}):\n{}\n{}".format(
+            proc.returncode, proc.stdout[-2000:], proc.stderr[-2000:]),
+            file=sys.stderr)
+        return proc.returncode, None
+    return proc.returncode, doc
+
+
+def main():
+    target_mb = float(os.environ.get("LDDL_TPU_STATUS_SMOKE_MB", "0.5"))
+    tmp = tempfile.mkdtemp(prefix="lddl_status_smoke_")
+    try:
+        from lddl_tpu.preprocess import build_wordpiece_vocab
+
+        corpus = os.path.join(tmp, "corpus")
+        bench.make_corpus(corpus, target_mb, seed=0)
+        sample, sample_bytes = [], 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 300_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=8000)
+        pre = os.path.join(tmp, "pre")
+        data = os.path.join(tmp, "data")
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        t0 = time.perf_counter()
+        for cmd in (
+            [sys.executable, "-m",
+             "lddl_tpu.cli.preprocess_bert_pretrain",
+             "--wikipedia", corpus, "--sink", pre,
+             "--vocab-file", vocab, "--masking",
+             "--bin-size", "32", "--num-blocks", "8",
+             "--seed", "7", "--local-workers", "2"],
+            [sys.executable, "-m", "lddl_tpu.cli.balance_shards",
+             "--indir", pre, "--outdir", data, "--num-shards", "4",
+             "--fleet-telemetry"],
+            [sys.executable, "-c", _LOADER_DRIVER, data, vocab],
+        ):
+            rc = subprocess.call(cmd, env=env, stdout=subprocess.DEVNULL,
+                                 stderr=subprocess.STDOUT)
+            if rc != 0:
+                print("status smoke: leg failed rc={} ({})".format(
+                    rc, cmd[2][:60]), file=sys.stderr)
+                return 1
+        report = {"pipeline_wall_s": round(time.perf_counter() - t0, 1)}
+
+        rc, doc = _status(data, "--window", "600")
+        if doc is None:
+            return 1
+        if rc != 0:
+            print("status smoke: healthy run exited {} ({})".format(
+                rc, doc.get("health", {}).get("verdicts")),
+                file=sys.stderr)
+            return 1
+        attr = doc.get("attribution")
+        if not attr or "verdict" not in attr:
+            print("status smoke: no attribution verdict in the rollup "
+                  "(loader leg left no stage counters?)", file=sys.stderr)
+            return 1
+        window = doc.get("window") or {}
+        if not window.get("rates"):
+            print("status smoke: --window reported no series rates",
+                  file=sys.stderr)
+            return 1
+        report["verdict"] = attr["verdict"]
+        report["input_share"] = round(attr.get("input_share", 0.0), 3)
+        report["windowed_metrics"] = len(window["rates"])
+
+        rules = os.path.join(tmp, "rules.json")
+        with open(rules, "w") as f:
+            json.dump({"rules": [
+                {"name": "tripped", "type": "threshold",
+                 "metric": "totals.counters.units_completed",
+                 "op": ">=", "value": 0},
+            ]}, f)
+        rc, doc = _status(data, "--alerts", rules)
+        if doc is None:
+            return 1
+        if rc != 2 or doc["alerts"]["firing"] != ["tripped"]:
+            print("status smoke: tripped alert rule did not force exit 2 "
+                  "(rc={}, firing={})".format(
+                      rc, doc.get("alerts", {}).get("firing")),
+                  file=sys.stderr)
+            return 1
+        with open(rules, "w") as f:
+            json.dump({"rules": [
+                {"name": "tripped", "type": "threshold",
+                 "metric": "totals.counters.units_completed",
+                 "op": "<", "value": 0},
+            ]}, f)
+        rc, doc = _status(data, "--alerts", rules)
+        if doc is None:
+            return 1
+        kinds = [t["kind"] for t in doc["alerts"]["transitions"]]
+        if rc != 0 or kinds != ["alert.resolved"]:
+            print("status smoke: relaxed rules did not resolve cleanly "
+                  "(rc={}, transitions={})".format(rc, kinds),
+                  file=sys.stderr)
+            return 1
+        report["alert_fire_resolve"] = True
+        print(json.dumps(report, sort_keys=True))
+        return 0
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

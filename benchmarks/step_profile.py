@@ -228,6 +228,75 @@ def packed_compare(args):
     return 0
 
 
+def attribution_profile(args):
+    """Run the REAL jitted train step (``mock_train --with-model tiny``)
+    with telemetry armed and merge the loader critical-path attribution
+    — bound verdict, input share, per-stage wall shares — into
+    STEP_PROFILE.json under ``loader_attribution`` (existing
+    device-trace fields preserved, same merge discipline as
+    ``--packed-compare``)."""
+    import json as _json
+    import tempfile as _tf
+    sys.path.insert(0, ROOT)
+    from bench import make_corpus
+    from lddl_tpu.balance import balance_shards
+    from lddl_tpu.preprocess import (BertPretrainConfig,
+                                     build_wordpiece_vocab, get_tokenizer,
+                                     run_bert_preprocess)
+    import jax
+    tmp = _tf.mkdtemp(prefix="lddl_attr_")
+    try:
+        corpus = os.path.join(tmp, "corpus")
+        make_corpus(corpus, args.corpus_mb, seed=0)
+        sample, sb = [], 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sb += len(line)
+                if sb > 1_000_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=30522)
+        tok = get_tokenizer(vocab_file=vocab)
+        pre = os.path.join(tmp, "pre")
+        run_bert_preprocess(
+            {"wikipedia": corpus}, pre, tok,
+            config=BertPretrainConfig(max_seq_length=128,
+                                      duplicate_factor=1),
+            num_blocks=8, sample_ratio=1.0, seed=12345,
+            num_workers=os.cpu_count())
+        bal = os.path.join(tmp, "bal")
+        balance_shards(pre, bal, 8)
+        mdir = os.path.join(tmp, "metrics")
+        _mock_train_packed(bal, vocab, ["--batch-size", str(args.batch),
+                                        "--metrics-dir", mdir])
+        summaries = sorted(glob.glob(os.path.join(mdir, "summary-*.json")))
+        if not summaries:
+            raise RuntimeError("mock_train left no summary under " + mdir)
+        attr = None
+        for sp in summaries:
+            with open(sp) as f:
+                attr = _json.load(f).get("loader_attribution") or attr
+        if attr is None:
+            raise RuntimeError("no loader_attribution in " + summaries[-1])
+        attr = dict(attr, device=getattr(
+            jax.devices()[0], "device_kind", str(jax.devices()[0])))
+        doc = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                doc = _json.load(f)
+        doc["loader_attribution"] = attr
+        with open(args.out, "w") as f:
+            _json.dump(doc, f, indent=1)
+        print(_json.dumps(attr, indent=1))
+        print("wrote", args.out)
+    finally:
+        import shutil
+        shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--model", default="bert_large",
@@ -253,9 +322,17 @@ def main():
                    help="--packed-compare row budget")
     p.add_argument("--pack-rows", type=int, default=4,
                    help="--packed-compare rows per batch")
+    p.add_argument("--attribution", action="store_true",
+                   help="skip the device trace: run mock_train "
+                        "--with-model tiny with telemetry armed and merge "
+                        "the loader critical-path attribution (bound "
+                        "verdict + per-stage shares) into the artifact "
+                        "(runs on any backend, CPU included)")
     args = p.parse_args()
     if args.packed_compare:
         return packed_compare(args)
+    if args.attribution:
+        return attribution_profile(args)
 
     import jax
     from lddl_tpu.loader import to_device_batch

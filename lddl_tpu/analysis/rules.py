@@ -83,6 +83,8 @@ class WallClockRule(Rule):
              "lddl_tpu/observability/tracing.py",
              "lddl_tpu/observability/exporters.py",
              "lddl_tpu/observability/fleet.py",
+             "lddl_tpu/observability/series.py",
+             "lddl_tpu/observability/alerts.py",
              "lddl_tpu/observability/__init__.py",
              "benchmarks/*",
              "lddl_tpu/resilience/leases.py")

@@ -6,7 +6,8 @@ Reference parity: the ``balance_dask_output`` console script
 """
 
 from ..balance import balance_shards
-from .common import (apply_storage_backend, attach_multihost_arg,
+from .common import (apply_storage_backend, arm_fleet_if_requested,
+                     attach_fleet_arg, attach_multihost_arg,
                      attach_storage_arg, communicator_of, make_parser)
 
 
@@ -20,12 +21,14 @@ def attach_args(parser=None):
                              "(num data-parallel groups x loader workers)")
     attach_multihost_arg(parser)
     attach_storage_arg(parser)
+    attach_fleet_arg(parser)
     return parser
 
 
 def main(args=None):
     args = args if args is not None else attach_args().parse_args()
     apply_storage_backend(args)
+    arm_fleet_if_requested(args, args.outdir)
     comm = communicator_of(args)
     counts = balance_shards(args.indir, args.outdir, args.num_shards,
                             comm=comm, log=print)

@@ -67,6 +67,25 @@ class _EpochObserver:
             self._gauge.set(self._real.total() / padded)
 
 
+def _timed_collate(collate):
+    """Wrap a collate once per epoch with the ``collate`` stage
+    accumulator (attribution). One wrap, zero per-batch branches; the
+    wrapped function is semantically identical, so batch bytes are
+    untouched. Only called when telemetry is enabled."""
+    import time
+    from ..observability import attribution
+    stage = attribution.stage_counter()
+    pc = time.perf_counter
+
+    def timed(batch, _c=collate, _s=stage, _pc=pc):
+        t0 = _pc()
+        out = _c(batch)
+        _s.inc(_pc() - t0, stage="collate")
+        return out
+
+    return timed
+
+
 def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
                       rng_spec, out_q):
     """Stream one epoch's collated batches into the queue.
@@ -84,6 +103,11 @@ def _stream_one_epoch(dataset, worker_idx, epoch, batch_size, collate_fn,
             collate = lambda b: collate_fn(b, g=g)  # noqa: E731
         else:
             collate = collate_fn or (lambda b: b)
+        if obs.enabled():
+            # Spawned workers inherit LDDL_TPU_METRICS_DIR: their collate
+            # stage seconds land in the child registry and reach the
+            # fleet rollup via the colocated per-pid exports.
+            collate = _timed_collate(collate)
 
         def put_batch(b):
             # Chaos-harness site: a "worker:kill" fault SIGKILLs this
@@ -137,6 +161,16 @@ class DataLoader:
             raise ValueError("batch_size must be >= 1")
         if worker_mode not in ("thread", "process"):
             raise ValueError("worker_mode must be thread|process")
+        # A loader process armed ONLY through the env (LDDL_TPU_FLEET_DIR,
+        # the documented equivalent of --fleet-telemetry) never calls
+        # configure() or record(), so nothing would start the heartbeat or
+        # point the metrics spool — and every obs.enabled() gate below
+        # would read False. Kick the fleet once here, before any gate.
+        try:
+            from ..observability import fleet
+            fleet.ensure_started()
+        except Exception:  # noqa: BLE001 - telemetry must stay inert
+            pass
         if worker_mode == "process":
             worker_mode = self._check_process_mode(dataset)
         self.dataset = dataset
@@ -218,6 +252,8 @@ class DataLoader:
                     continue
             return False
 
+        if obs.enabled():
+            collate = _timed_collate(collate)
         try:
             batch = []
             for sample in stream:
@@ -460,6 +496,11 @@ class DataLoader:
         served = [0] * n    # batches yielded to the consumer, per worker
         restarts = [0] * n  # deaths survived this epoch, per worker
         skip = [0] * n      # replayed batches to discard after a restart
+        obs_on = obs.enabled()
+        if obs_on:
+            import time as _time
+            from ..observability import attribution
+            stage, pc = attribution.stage_counter(), _time.perf_counter
         try:
             while live:
                 for w in list(live):
@@ -512,7 +553,16 @@ class DataLoader:
                     served[w] += 1
                     self.queue_bytes += len(payload)
                     self.queue_batches += 1
-                    yield qserde.decode(payload)
+                    if obs_on:
+                        # IPC stage: the cross-process payload decode the
+                        # thread mode never pays (queue WAIT is already
+                        # covered by the batch_wait boundary upstream).
+                        t0 = pc()
+                        decoded = qserde.decode(payload)
+                        stage.inc(pc() - t0, stage="ipc")
+                        yield decoded
+                    else:
+                        yield qserde.decode(payload)
         finally:
             if live:
                 # Failed or abandoned mid-epoch: workers are mid-stream
@@ -539,20 +589,39 @@ class DataLoader:
     def _iter_instrumented(self, inner):
         """Top-level loader span + per-batch latency/padding accounting.
         Wall time between consumer next() calls is the batch latency the
-        training loop actually experiences (prefetch included)."""
+        training loop actually experiences (prefetch included). The same
+        two timestamps also feed the attribution boundary pair:
+        ``batch_wait`` (consumer blocked in next()) and ``step_gap``
+        (consumer away between batches) partition the epoch wall exactly
+        — the input-share those yield is what the bound verdict reads."""
         import time
+        from ..observability import attribution
         watcher = _EpochObserver()
+        stage = attribution.stage_counter()
         try:
             with obs.span("loader.epoch", mode=self._worker_mode,
                           batch_size=self.batch_size):
                 t0 = time.perf_counter()
                 for batch in inner:
-                    watcher.batch(batch, time.perf_counter() - t0)
+                    t_ready = time.perf_counter()
+                    watcher.batch(batch, t_ready - t0)
+                    stage.inc(t_ready - t0, stage="batch_wait")
                     yield batch
                     t0 = time.perf_counter()
+                    stage.inc(t0 - t_ready, stage="step_gap")
         finally:
             # Abandoned epochs still summarize what they served.
             watcher.finish()
+
+    def attribution_snapshot(self):
+        """Critical-path attribution accumulated so far in this process:
+        per-stage self-time seconds, per-stage shares of the observed
+        wall, and the bound verdict (input-bound / compute-bound /
+        balanced). None when telemetry is off or nothing has iterated.
+        Registry-wide by design — stages recorded by worker threads and
+        the device prefetcher all land in the same accumulator."""
+        from ..observability import attribution
+        return attribution.snapshot()
 
     def _iter_thread(self):
         streams = self.dataset.start_epoch()
@@ -611,13 +680,33 @@ class _DevicePrefetcher:
                     continue
             return False
 
+        obs_on = obs.enabled()
+        if obs_on:
+            from ..observability import attribution
+            reg = obs.registry()
+            batches = reg.counter("loader_prefetch_batches_total")
+            wait = reg.histogram("loader_prefetch_wait_seconds")
+            stage = attribution.stage_counter()
+        device_put = self._device_put
+        if obs_on:
+            import time as _time
+
+            def device_put(b, _d=self._device_put, _s=stage,
+                           _pc=_time.perf_counter):
+                # h2d stage: the dispatch cost of the transfer (the
+                # transfer itself is asynchronous — overlap is the point).
+                t0 = _pc()
+                out = _d(b)
+                _s.inc(_pc() - t0, stage="h2d")
+                return out
+
         def produce():
             try:
                 for batch in self._loader:
                     # device_put dispatches the H2D transfer
                     # asynchronously; the consumer's current step overlaps
                     # with the NEXT batch's host collate + transfer.
-                    if not put(("batch", self._device_put(batch))):
+                    if not put(("batch", device_put(batch))):
                         return
                 put(("end", None))
             except BaseException as e:  # noqa: BLE001 - forwarded
@@ -625,23 +714,26 @@ class _DevicePrefetcher:
 
         t = threading.Thread(target=produce, daemon=True)
         t.start()
-        obs_on = obs.enabled()
-        if obs_on:
-            reg = obs.registry()
-            batches = reg.counter("loader_prefetch_batches_total")
-            wait = reg.histogram("loader_prefetch_wait_seconds")
         try:
             import time
+            t_yield = None
             while True:
                 t0 = time.perf_counter() if obs_on else 0.0
+                if obs_on and t_yield is not None:
+                    # The consumer was away running its step: that gap is
+                    # the compute side of the outermost boundary pair.
+                    stage.inc(t0 - t_yield, stage="prefetch_gap")
                 kind, payload = q.get()
                 if kind == "error":
                     raise payload
                 if kind == "end":
                     return
                 if obs_on:
+                    dt = time.perf_counter() - t0
                     batches.inc()
-                    wait.observe(time.perf_counter() - t0)
+                    wait.observe(dt)
+                    stage.inc(dt, stage="prefetch_wait")
+                    t_yield = time.perf_counter()
                 yield payload
         finally:
             stop.set()
@@ -699,6 +791,13 @@ class Binned:
         thread mode)."""
         for dl in self._dataloaders:
             dl.shutdown_workers()
+
+    def attribution_snapshot(self):
+        """Critical-path attribution + bound verdict across every bin
+        (the stage accumulator is registry-wide; see
+        DataLoader.attribution_snapshot)."""
+        from ..observability import attribution
+        return attribution.snapshot()
 
     def __iter__(self):
         self._epoch += 1

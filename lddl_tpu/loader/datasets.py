@@ -104,14 +104,42 @@ class ShuffleBuffer:
             "loader_shuffle_buffer_fill",
             help="shuffle-buffer occupancy / configured size") if obs_on \
             else None
+        decode = self._decode_record_batch
+        if obs_on:
+            # Attribution stages, hoisted like the gauge: shard_read is
+            # the blocking parquet read, decode is the time spent inside
+            # the sample generator (timed per resume — two perf_counter
+            # reads per sample, same budget as the swap-replace itself).
+            import time as _time
+            from ..observability import attribution
+            stage = attribution.stage_counter()
+            pc = _time.perf_counter
+
+            def decode(rb, _d=self._decode_record_batch, _s=stage, _pc=pc):
+                it = iter(_d(rb))
+                while True:
+                    t0 = _pc()
+                    try:
+                        sample = next(it)
+                    except StopIteration:
+                        _s.inc(_pc() - t0, stage="decode")
+                        return
+                    _s.inc(_pc() - t0, stage="decode")
+                    yield sample
 
         for f in self._files:
             if self._logger is not None:
                 self._logger.to("worker").info("Reading {}".format(f.path))
             # Resilient shard read: transient EIO/ESTALE retries with
             # backoff instead of killing the epoch (resilience.io).
-            for record_batch in read_table(f.path).to_batches():
-                for sample in self._decode_record_batch(record_batch):
+            if obs_on:
+                t0 = pc()
+                table = read_table(f.path)
+                stage.inc(pc() - t0, stage="shard_read")
+            else:
+                table = read_table(f.path)
+            for record_batch in table.to_batches():
+                for sample in decode(record_batch):
                     if remaining <= 0:
                         return
                     warmup_cap = (num_to_yield - remaining + 1) * self._warmup_factor

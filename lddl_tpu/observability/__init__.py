@@ -25,7 +25,7 @@ Quick tour::
     obs.write_summary()          # summary-*.json + trace flush
 """
 
-from . import fleet
+from . import alerts, attribution, fleet, series
 from .exporters import (
     configure,
     disable,
@@ -56,6 +56,8 @@ __all__ = [
     "Gauge",
     "Histogram",
     "Registry",
+    "alerts",
+    "attribution",
     "configure",
     "disable",
     "enabled",
@@ -69,6 +71,7 @@ __all__ = [
     "metrics_dir",
     "observe",
     "registry",
+    "series",
     "set_gauge",
     "span",
     "start_periodic_export",

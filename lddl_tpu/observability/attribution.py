@@ -1,0 +1,165 @@
+"""Loader critical-path attribution: where does batch wall time go?
+
+The question an operator actually asks — "is my step input-bound or
+compute-bound, and if input-bound, which loader stage is on the critical
+path?" — cannot be answered from lifetime counters alone. This module
+defines the stage vocabulary, the accumulation metric, and the verdict
+rule; the instrumentation sites live in ``loader/dataloader.py`` and
+``loader/datasets.py`` (guarded by ``registry.enabled()`` like every
+other hook, so the disabled hot path stays one env lookup).
+
+Stage vocabulary (``loader_stage_seconds_total{stage=...}``):
+
+============== =========================================================
+self-time stages (pipeline work, mostly overlapped by worker threads)
+--------------------------------------------------------------------------
+``shard_read``  blocking parquet shard read (``read_table``)
+``decode``      Arrow record-batch -> sample dict decode
+``collate``     sample list -> padded/packed batch assembly
+``ipc``         process-mode queue wait + payload decode (qserde)
+``h2d``         device_put / host-to-device transfer in the prefetcher
+-------------- ---------------------------------------------------------
+boundary stages (partition the consumer-observed wall exactly)
+--------------------------------------------------------------------------
+``batch_wait``  consumer blocked in ``__next__`` waiting for a batch
+``step_gap``    consumer away between batches (its compute step)
+``prefetch_wait``/``prefetch_gap``
+                the same pair measured at the device-prefetch boundary
+                (preferred when present: it is the outermost iterator)
+============== =========================================================
+
+Verdict rule: with ``wall = wait + gap`` at the outermost boundary,
+``input_share = wait / wall``. ``input-bound`` when input_share >= 0.40,
+``compute-bound`` when <= 0.15, ``balanced`` between. Shares reported
+per stage partition the wall exactly: the gap is ``consumer_step`` and
+the wait is split across the self-time stages proportionally to their
+accumulated seconds (``queue_wait`` absorbs it when no self-time was
+observed, e.g. all stages ran in unobserved worker processes).
+
+Everything here is pure arithmetic over counters — no clocks (the
+instrumentation sites use ``perf_counter`` intervals), no RNG, nothing
+that can raise into the pipeline.
+"""
+
+from .registry import enabled, registry, set_gauge
+
+STAGE_METRIC = "loader_stage_seconds_total"
+VERDICT_GAUGE = "loader_bound_verdict"
+INPUT_SHARE_GAUGE = "loader_input_share"
+
+# Self-time stages, in the order the batch path visits them.
+STAGES = ("shard_read", "decode", "collate", "ipc", "h2d")
+
+INPUT_BOUND_SHARE = 0.40
+COMPUTE_BOUND_SHARE = 0.15
+
+# Gauge encoding of the verdict (exported through fleet snapshots):
+# +1 input-bound, 0 balanced, -1 compute-bound.
+VERDICT_VALUE = {"input-bound": 1.0, "balanced": 0.0, "compute-bound": -1.0}
+
+
+def stage_counter():
+    """The shared per-stage accumulator (instrumentation sites cache the
+    handle per epoch and ``inc(dt, stage=...)`` into it)."""
+    return registry().counter(
+        STAGE_METRIC, help="accumulated loader self-time per stage (s)")
+
+
+def stage_seconds():
+    """{stage: seconds} accumulated so far in this process's registry."""
+    m = registry().get(STAGE_METRIC)
+    if m is None or m.kind != "counter":
+        return {}
+    out = {}
+    for label_str, v in m.snapshot().get("values", {}).items():
+        for part in label_str.split(","):
+            k, _, stage = part.partition("=")
+            if k == "stage" and stage:
+                out[stage] = out.get(stage, 0.0) + v
+    return out
+
+
+def from_stage_seconds(stages):
+    """The attribution report for accumulated ``{stage: seconds}``, or
+    None when no boundary pair was observed (nothing iterated). Pure
+    function — the fleet aggregator calls this on spool bytes alone."""
+    try:
+        wait = float(stages.get("prefetch_wait", 0.0))
+        gap = float(stages.get("prefetch_gap", 0.0))
+        boundary = "prefetch"
+        if wait + gap <= 0.0:
+            wait = float(stages.get("batch_wait", 0.0))
+            gap = float(stages.get("step_gap", 0.0))
+            boundary = "loader"
+        wall = wait + gap
+        if wall <= 0.0:
+            return None
+        input_share = wait / wall
+        if input_share >= INPUT_BOUND_SHARE:
+            verdict = "input-bound"
+        elif input_share <= COMPUTE_BOUND_SHARE:
+            verdict = "compute-bound"
+        else:
+            verdict = "balanced"
+        self_times = {s: float(stages.get(s, 0.0)) for s in STAGES
+                      if float(stages.get(s, 0.0)) > 0.0}
+        self_total = sum(self_times.values())
+        shares = {"consumer_step": gap / wall}
+        if self_total > 0.0:
+            for s, v in self_times.items():
+                shares[s] = input_share * (v / self_total)
+        elif wait > 0.0:
+            shares["queue_wait"] = input_share
+        top = max(((s, sh) for s, sh in shares.items()
+                   if s != "consumer_step"),
+                  key=lambda kv: kv[1], default=(None, 0.0))
+        return {
+            "verdict": verdict,
+            "input_share": input_share,
+            "wall_seconds": wall,
+            "boundary": boundary,
+            "stages_seconds": {s: float(v) for s, v in stages.items()
+                               if float(v) > 0.0},
+            "shares": shares,
+            "top_stage": {"stage": top[0], "share": top[1]},
+        }
+    except (TypeError, ValueError):
+        return None
+
+
+def snapshot():
+    """Attribution off the live registry. Also publishes the verdict and
+    input-share gauges, so fleet snapshots (and therefore the rollup)
+    carry them without re-deriving. None when telemetry is off or the
+    loader has not iterated."""
+    if not enabled():
+        return None
+    report = from_stage_seconds(stage_seconds())
+    if report is None:
+        return None
+    set_gauge(VERDICT_GAUGE, VERDICT_VALUE[report["verdict"]])
+    set_gauge(INPUT_SHARE_GAUGE, report["input_share"])
+    return report
+
+
+def format_report(report, indent=""):
+    """Human-readable attribution block (mock_train's final report)."""
+    if not report:
+        return indent + "loader attribution: no batches observed"
+    lines = [indent + "loader bound verdict: {} (input share {:.1%} of "
+             "{:.2f}s observed wall, {} boundary)".format(
+                 report["verdict"], report["input_share"],
+                 report["wall_seconds"], report["boundary"])]
+    top = report.get("top_stage") or {}
+    if top.get("stage"):
+        lines.append(indent + "top contributing stage: {} ({:.1%})"
+                     .format(top["stage"], top["share"]))
+    for stage, share in sorted(report["shares"].items(),
+                               key=lambda kv: -kv[1]):
+        lines.append(indent + "  {:<14s} {:6.1%}  ({:.3f}s)".format(
+            stage, share,
+            report["stages_seconds"].get(
+                stage if stage != "consumer_step" else
+                ("prefetch_gap" if report["boundary"] == "prefetch"
+                 else "step_gap"), 0.0)))
+    return "\n".join(lines)

@@ -183,6 +183,14 @@ def summary():
             "resilience_quarantined_shards_total"),
         "metrics": snap,
     }
+    # Critical-path attribution rides the summary so downstream readers
+    # (step_profile --attribution, the fleet rollup) never re-derive it.
+    try:
+        from . import attribution
+        out["loader_attribution"] = attribution.from_stage_seconds(
+            attribution.stage_seconds())
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        out["loader_attribution"] = None
     return out
 
 

@@ -72,10 +72,20 @@ ENV_FLEET_DIR = "LDDL_TPU_FLEET_DIR"
 ENV_HOLDER = "LDDL_TPU_FLEET_HOLDER"
 ENV_INTERVAL = "LDDL_TPU_FLEET_INTERVAL_S"
 ENV_TTL = "LDDL_TPU_FLEET_TTL_S"
+ENV_ROTATE_BYTES = "LDDL_TPU_FLEET_ROTATE_BYTES"
+ENV_RETAIN_BYTES = "LDDL_TPU_FLEET_RETAIN_BYTES"
+ENV_RETAIN_AGE_S = "LDDL_TPU_FLEET_RETAIN_AGE_S"
 
 TELEMETRY_DIR = ".telemetry"
 DEFAULT_INTERVAL_S = 10.0
 DEFAULT_TTL_S = 30.0
+# Spool retention: append segments (events/series) freeze at the rotate
+# bound and start a .segNNNN successor; gc_spool drops frozen segments
+# and closed foreign snapshots past the total-size/age budget — the same
+# bounded-accumulation discipline the mock store's generation GC has.
+DEFAULT_ROTATE_BYTES = 4 << 20
+DEFAULT_RETAIN_BYTES = 64 << 20
+DEFAULT_RETAIN_AGE_S = 7 * 24 * 3600.0
 
 # A (wall - mono) offset drifting more than this from its first sample is
 # a wall-clock STEP (NTP slew stays far under it); merge_traces re-anchors
@@ -101,8 +111,9 @@ _SAFE_RE = re.compile(r"[^A-Za-z0-9_.-]+")
 _lock = threading.RLock()
 _events = []
 _started = []          # [True] once the heartbeat/exit hooks are live
-_hb = {"thread": None, "stop": None}
+_hb = {"thread": None, "stop": None, "beats": 0}
 _cached = {"raw": object(), "dir": None}
+_ev_segment = {"path": None}   # this pid's current events append segment
 _started_wall = time.time()
 
 
@@ -223,11 +234,105 @@ def _jsonable(v):
     return str(v)
 
 
-def _events_path():
-    d = spool_dir()
-    if d is None:
-        return None
-    return os.path.join(d, "events-pid{}.jsonl".format(os.getpid()))
+def rotating_path(d, prefix, state):
+    """The current append segment for this pid under ``d``: the base
+    ``<prefix><pid>.jsonl`` until it reaches the rotation bound, then
+    ``<prefix><pid>.segNNNN.jsonl`` successors. Rotation never renames
+    (os.replace is reserved for the resilience.io publish path) — a full
+    segment simply freezes and appends move to the next name, which the
+    readers' shared-prefix glob merges seamlessly. ``state`` is a
+    per-writer dict carrying the cached current path."""
+    base = os.path.join(d, "{}{}".format(prefix, os.getpid()))
+    path = state.get("path") or base + ".jsonl"
+    cap = _env_float(ENV_ROTATE_BYTES, DEFAULT_ROTATE_BYTES)
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        size = 0
+    if size >= cap:
+        seq = state.get("seq", 0) + 1
+        # A restart that reuses the pid must not append to a frozen
+        # segment from the previous life: skip to the first free name.
+        while os.path.exists("{}.seg{:04d}.jsonl".format(base, seq)):
+            seq += 1
+        state["seq"] = seq
+        path = "{}.seg{:04d}.jsonl".format(base, seq)
+    state["path"] = path
+    return path
+
+
+def gc_spool(d=None, now=None):
+    """Size/age-bounded retention for one spool dir. Candidates are
+    frozen (rotated) event/series segments that are not this process's
+    current append target, and closed snapshots left by OTHER pids
+    (generations and restarts otherwise accumulate them forever). A
+    candidate is dropped when it is older than the retention age, or
+    oldest-first while the spool exceeds the byte budget. Live segments
+    and open snapshots are never touched, so a host's current telemetry
+    survives any GC pass. Returns the number of files removed."""
+    d = d if d is not None else spool_dir()
+    if d is None or not os.path.isdir(d):
+        return 0
+    now = time.time() if now is None else float(now)
+    retain_bytes = _env_float(ENV_RETAIN_BYTES, DEFAULT_RETAIN_BYTES)
+    retain_age = _env_float(ENV_RETAIN_AGE_S, DEFAULT_RETAIN_AGE_S)
+    with _lock:
+        keep = {_ev_segment.get("path")}
+    try:
+        from . import series
+        with series._lock:
+            keep.add(series._segment.get("path"))
+    except Exception:  # noqa: BLE001 - best-effort; GC still runs
+        pass
+    total, candidates = 0, []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return 0
+    for name in names:
+        path = os.path.join(d, name)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        total += st.st_size
+        if path in keep:
+            continue
+        frozen = (".seg" in name and name.endswith(".jsonl") and
+                  (name.startswith("events-pid") or
+                   name.startswith("series-pid")))
+        stale_snap = False
+        if name.startswith("snapshot-pid") and name.endswith(".json"):
+            snap = _read_json(path, warn=lambda *a: None)
+            stale_snap = bool(snap) and bool(snap.get("closed")) \
+                and int(snap.get("pid", -1)) != os.getpid()
+        if frozen or stale_snap:
+            candidates.append((st.st_mtime, st.st_size, path))
+    candidates.sort()  # oldest first
+    removed = 0
+    for mtime, size, path in candidates:
+        if (now - mtime) <= retain_age and total <= retain_bytes:
+            continue
+        try:
+            os.remove(path)
+        except OSError:
+            continue
+        total -= size
+        removed += 1
+    return removed
+
+
+def _maybe_gc(every=6):
+    """Run retention every Nth heartbeat (the spool is small between
+    passes; a listdir per beat would be pure overhead)."""
+    try:
+        with _lock:
+            _hb["beats"] = _hb.get("beats", 0) + 1
+            if _hb["beats"] % every != 1:
+                return
+        gc_spool()
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass
 
 
 def _snapshot_path():
@@ -238,19 +343,25 @@ def _snapshot_path():
 
 
 def flush_events():
-    """Append buffered events to this process's spool event log. Each
-    line is written complete; only a mid-write crash can tear the final
-    line, which readers degrade to end-of-stream."""
-    path = _events_path()
+    """Append buffered events to this process's spool event log (current
+    rotation segment). Each line is written complete; only a mid-write
+    crash can tear the final line, which readers degrade to
+    end-of-stream."""
+    d = spool_dir()
     with _lock:
+        path = _ev_segment.get("path")
         if not _events:
+            if path is None and d is not None:
+                path = os.path.join(
+                    d, "events-pid{}.jsonl".format(os.getpid()))
             return path
         batch, _events[:] = list(_events), []
-    if path is None:
+    if d is None:
         return None
     try:
         from ..resilience import io as rio
-        os.makedirs(os.path.dirname(path), exist_ok=True)
+        os.makedirs(d, exist_ok=True)
+        path = rotating_path(d, "events-pid", _ev_segment)
         payload = "".join(json.dumps(ev, sort_keys=True) + "\n"
                           for ev in batch)
         with rio.open_append(path) as f:
@@ -303,6 +414,14 @@ def heartbeat(closed=False, reason=None):
     flush_events()
     path = publish_snapshot(closed=closed, reason=reason)
     try:
+        # Series history rides the same beat (and therefore the same
+        # atexit/SIGTERM/pre-kill flush paths) as the snapshot: a crash
+        # loses at most one interval of points plus maybe a torn line.
+        from . import series
+        series.sample_and_flush()
+    except Exception:  # noqa: BLE001 - best-effort history
+        pass
+    try:
         tracing.flush()
         d = metrics_dir()
         if d is not None and os.path.abspath(d) == os.path.abspath(
@@ -311,6 +430,7 @@ def heartbeat(closed=False, reason=None):
             exporters.export_jsonl()
     except Exception:  # noqa: BLE001 - best-effort colocated exports
         pass
+    _maybe_gc()
     return path
 
 
@@ -336,6 +456,15 @@ def ensure_started(interval=None):
     atexit.register(_final_flush)
     from . import exporters
     exporters.install_signal_flush()
+    # Arm-time stamp: a host that dies between configure() and the first
+    # heartbeat used to leave an EMPTY spool dir, indistinguishable from
+    # one that never started — and with no started_wall, the aggregator
+    # could not even age it into a STALLED verdict. Publish immediately
+    # so every armed process leaves at least a start stamp.
+    try:
+        publish_snapshot()
+    except Exception:  # noqa: BLE001 - telemetry must stay inert
+        pass
     if interval is None:
         interval = _env_float(ENV_INTERVAL, DEFAULT_INTERVAL_S)
     stop = threading.Event()
@@ -367,10 +496,15 @@ def _reset_for_tests():
     with _lock:
         _events[:] = []
         _started[:] = []
+        _ev_segment.clear()
+        _ev_segment["path"] = None
+        _hb["beats"] = 0
     if _hb["stop"] is not None:
         _hb["stop"].set()
     _hb["thread"] = None
     _hb["stop"] = None
+    from . import series
+    series._reset_for_tests()
 
 
 # ------------------------------------------------------------ spool reads
@@ -483,6 +617,23 @@ ROLLUP_COUNTERS = (
     ("ingest_docs", "ingest_docs_total"),
     ("generations_published", "ingest_generations_published_total"),
     ("loader_batches", "loader_batches_total"),
+    ("backend_ops", "backend_ops_total"),
+    ("backend_cas_conflicts", "backend_cas_conflicts_total"),
+    ("alerts_fired", "alerts_fired_total"),
+)
+
+# Labelled counters surfaced per host WITH their label breakdown (the
+# flat ROLLUP_COUNTERS sum above collapses labels; these keep them).
+ROLLUP_LABELLED = (
+    ("backend_ops", "backend_ops_total"),
+    ("loader_stage_seconds", "loader_stage_seconds_total"),
+    ("alerts_fired", "alerts_fired_total"),
+)
+
+# Histograms surfaced per host as merged count/sum/mean/max per label set
+# (per-{backend,op} storage op latency is the headline consumer).
+ROLLUP_HISTOGRAMS = (
+    ("backend_op_latency", "backend_op_latency_seconds"),
 )
 
 # Gauges reported at host level when present (latest snapshot wins).
@@ -514,6 +665,49 @@ def _gauge_value(snap_metrics, name):
         return None
     # Unlabelled gauge is the common case; otherwise take the max label.
     return values.get("", max(values.values()))
+
+
+def _labelled_totals(snaps, metric):
+    """{label_str: value} for one counter, summed over a holder's pids."""
+    agg = {}
+    for s in snaps:
+        data = (s.get("metrics") or {}).get(metric)
+        if not data or data.get("type") != "counter":
+            continue
+        for label_str, v in data.get("values", {}).items():
+            agg[label_str] = agg.get(label_str, 0) + v
+    return agg
+
+
+def _histogram_stats(snaps, metric):
+    """{label_str: {count, sum, mean, max}} for one histogram, merged
+    over a holder's pids (log buckets are dropped here — the windowed
+    series path carries percentiles; the rollup carries the moments)."""
+    agg = {}
+    for s in snaps:
+        data = (s.get("metrics") or {}).get(metric)
+        if not data or data.get("type") != "histogram":
+            continue
+        for label_str, st in data.get("values", {}).items():
+            cur = agg.setdefault(label_str,
+                                 {"count": 0, "sum": 0.0, "max": 0.0})
+            cur["count"] += st.get("count", 0)
+            cur["sum"] += st.get("sum", 0.0)
+            cur["max"] = max(cur["max"], st.get("max", 0.0) or 0.0)
+    for cur in agg.values():
+        cur["mean"] = (cur["sum"] / cur["count"]) if cur["count"] else None
+    return agg
+
+
+def _stage_seconds_of(labelled):
+    """{stage: seconds} off a ``loader_stage_seconds`` label breakdown."""
+    out = {}
+    for label_str, v in (labelled or {}).items():
+        for part in label_str.split(","):
+            k, _, stage = part.partition("=")
+            if k == "stage" and stage:
+                out[stage] = out.get(stage, 0.0) + v
+    return out
 
 
 def _host_rollup(spool, now, stall_ttl):
@@ -559,6 +753,24 @@ def _host_rollup(spool, now, stall_ttl):
         event_counts[k] = event_counts.get(k, 0) + 1
     progress = [ev.get("wall", 0.0) for ev in spool["events"]
                 if ev.get("kind") in PROGRESS_EVENTS]
+    labelled = {}
+    for key, metric in ROLLUP_LABELLED:
+        vals = _labelled_totals(snaps, metric)
+        if vals:
+            labelled[key] = vals
+    histograms = {}
+    for key, metric in ROLLUP_HISTOGRAMS:
+        vals = _histogram_stats(snaps, metric)
+        if vals:
+            histograms[key] = vals
+    attribution_report = None
+    stage_s = _stage_seconds_of(labelled.get("loader_stage_seconds"))
+    if stage_s:
+        try:
+            from . import attribution
+            attribution_report = attribution.from_stage_seconds(stage_s)
+        except Exception:  # noqa: BLE001 - rollup survives a bad snapshot
+            attribution_report = None
     return {
         "holder": spool["holder"],
         "pids": sorted(spool["snapshots"]),
@@ -571,6 +783,9 @@ def _host_rollup(spool, now, stall_ttl):
         "counters": counters,
         "gauges": gauges,
         "rates": rates,
+        "labelled": labelled,
+        "histograms": histograms,
+        "attribution": attribution_report,
         "events_total": len(spool["events"]),
         "event_counts": event_counts,
         "torn_lines": spool["torn_lines"],
@@ -627,15 +842,25 @@ def _journal_state(root):
     return max(gens) if gens else None
 
 
-def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
+def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None,
+              window=None):
     """Merge every host spool under ``<root>/.telemetry/`` into one
     cluster report with health verdicts. Pure function of the spool
     bytes, ``now`` (defaults to this process's wall clock — the one
-    clock read the status CLI delegates here) and the two thresholds."""
+    clock read the status CLI delegates here) and the thresholds.
+    ``window`` (seconds) additionally loads each holder's series
+    segments and attaches windowed rates/trends/percentiles per host
+    plus a cluster ``window`` block (rates summed across hosts)."""
     now = time.time() if now is None else float(now)
+    from . import series as series_mod
     hosts = {}
     for h in list_holders(root):
         hosts[h] = _host_rollup(load_spool(root, h, warn), now, stall_ttl)
+        if window:
+            points, torn = series_mod.read_series(root, h, warn)
+            hosts[h]["window"] = series_mod.window_rollup(
+                points, window, now)
+            hosts[h]["torn_lines"] += torn
     totals = {key: sum(h["counters"][key] for h in hosts.values())
               for key, _ in ROLLUP_COUNTERS}
     if totals.get("pack_slot_tokens"):
@@ -659,7 +884,7 @@ def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
     last_progress = max(progress) if progress else None
     ttl = stall_ttl if stall_ttl is not None else max(
         (st["stall_ttl_s"] for st in hosts.values()), default=DEFAULT_TTL_S)
-    window = wedge_window if wedge_window is not None \
+    wedge_win = wedge_window if wedge_window is not None \
         else max(4.0 * ttl, 120.0)
     pending = _pending_work(root, hosts)
     # "No progress EVER" must not instant-wedge a freshly started run
@@ -671,7 +896,7 @@ def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
     baseline = last_progress if last_progress is not None \
         else (min(started) if started else None)
     wedged = bool(live) and pending is not None and (
-        baseline is not None and (now - baseline) > window)
+        baseline is not None and (now - baseline) > wedge_win)
     verdicts = []
     for h in stalled:
         verdicts.append(
@@ -684,17 +909,58 @@ def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
         verdicts.append(
             "service WEDGED: {} live host(s) with {} but last "
             "journal/ledger progress was {} (window {:.1f}s)".format(
-                len(live), pending, age, window))
+                len(live), pending, age, wedge_win))
     for h, st in sorted(hosts.items()):
         if st["torn_lines"]:
             verdicts.append(
                 "host {}: {} torn spool line(s) tolerated (host died "
                 "mid-append?)".format(h, st["torn_lines"]))
+    # Cluster storage-backend view: op counts and merged latency moments
+    # per {backend,op,outcome} (pipeline_status --json surfaces these so
+    # mock-vs-local op cost is visible from telemetry alone).
+    backend_ops, backend_latency = {}, {}
+    for st in hosts.values():
+        for label_str, v in st["labelled"].get("backend_ops", {}).items():
+            backend_ops[label_str] = backend_ops.get(label_str, 0) + v
+        for label_str, h_ in st["histograms"].get(
+                "backend_op_latency", {}).items():
+            cur = backend_latency.setdefault(
+                label_str, {"count": 0, "sum": 0.0, "max": 0.0})
+            cur["count"] += h_.get("count", 0)
+            cur["sum"] += h_.get("sum", 0.0)
+            cur["max"] = max(cur["max"], h_.get("max", 0.0) or 0.0)
+    for cur in backend_latency.values():
+        cur["mean"] = (cur["sum"] / cur["count"]) if cur["count"] else None
+    # Cluster attribution: stage seconds summed across hosts, then one
+    # fleet-wide bound verdict (a mean of verdicts would weight hosts,
+    # not wall time — same reasoning as the pack-fill recompute above).
+    cluster_stages = {}
+    for st in hosts.values():
+        for stage, v in _stage_seconds_of(
+                st["labelled"].get("loader_stage_seconds")).items():
+            cluster_stages[stage] = cluster_stages.get(stage, 0.0) + v
+    cluster_attr = None
+    if cluster_stages:
+        try:
+            from . import attribution
+            cluster_attr = attribution.from_stage_seconds(cluster_stages)
+        except Exception:  # noqa: BLE001 - report survives bad metrics
+            cluster_attr = None
+    report_window = None
+    if window:
+        wrates = {}
+        for st in hosts.values():
+            for key, r in st.get("window", {}).get("rates", {}).items():
+                wrates[key] = wrates.get(key, 0.0) + r
+        report_window = {"window_s": float(window), "rates": wrates}
     return {
         "root": os.path.abspath(root),
         "generated_wall": now,
         "hosts": hosts,
         "totals": {"counters": totals, "rates": total_rates},
+        "backend": {"ops": backend_ops, "latency": backend_latency},
+        "attribution": cluster_attr,
+        "window": report_window,
         "journal_generation": _journal_state(root),
         "pending_work": pending,
         "last_progress_wall": last_progress,
@@ -706,7 +972,7 @@ def aggregate(root, now=None, stall_ttl=None, wedge_window=None, warn=None):
                                    if st["closed"]),
             "wedged": wedged,
             "stall_ttl_s": ttl,
-            "wedge_window_s": window,
+            "wedge_window_s": wedge_win,
             "verdicts": verdicts,
         },
     }

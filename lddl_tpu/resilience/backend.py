@@ -64,6 +64,7 @@ import threading
 
 from . import faults
 from ..observability import inc as obs_inc
+from ..observability import observe as obs_observe
 
 ENV_VAR = "LDDL_TPU_STORAGE_BACKEND"
 BACKENDS = ("local", "mock")
@@ -89,6 +90,14 @@ def count(backend, op, outcome):
     """One backend operation outcome into ``backend_ops_total`` — the
     cross-backend cost/outcome headline (labels documented in README)."""
     obs_inc("backend_ops_total", backend=backend, op=op, outcome=outcome)
+
+
+def observe_latency(backend, op, seconds):
+    """One backend operation latency into
+    ``backend_op_latency_seconds{backend,op}`` — the per-op cost
+    distribution the status CLI reads back out of the fleet rollup."""
+    obs_observe("backend_op_latency_seconds", seconds,
+                backend=backend, op=op)
 
 
 def _conflict(backend, path, op):

@@ -39,6 +39,7 @@ import time
 
 from . import backend as _backend
 from . import faults
+from ..observability import enabled as obs_enabled
 from ..observability import event as obs_event
 from ..observability import fleet
 from ..observability import inc as obs_inc
@@ -82,6 +83,19 @@ def _mock_backend():
     if (os.environ.get(_backend.ENV_VAR) or "local") == "local":
         return None
     return _backend.get_backend()
+
+
+def _lat_start():
+    """Latency-timing start marker, or None with telemetry disarmed (the
+    disabled hot path stays one env lookup — no clock read)."""
+    return time.perf_counter() if obs_enabled() else None
+
+
+def _lat_end(t0, op):
+    """Close a latency interval into the per-{backend,op} histogram."""
+    if t0 is not None:
+        _backend.observe_latency(_backend.active_name(), op,
+                                 time.perf_counter() - t0)
 
 
 _jitter_rng = random.Random()
@@ -179,12 +193,14 @@ def atomic_publish(tmp_path, path, fsync_file=True):
     published via multipart-upload-then-commit and the temp is consumed
     (unlinked) to keep the caller contract identical."""
     bk = _mock_backend()
+    t0 = _lat_start()
     if bk is not None:
         bk.put_file(tmp_path, path)
         try:
             os.unlink(tmp_path)
         except OSError:
             pass
+        _lat_end(t0, "put")
         return
     if fsync_file:
         fd = os.open(tmp_path, os.O_RDONLY)
@@ -196,6 +212,7 @@ def atomic_publish(tmp_path, path, fsync_file=True):
     os.replace(tmp_path, path)
     _fsync_dir(path)
     _backend.count("local", "put", "ok")
+    _lat_end(t0, "put")
 
 
 def atomic_write(path, data, retries=True):
@@ -287,10 +304,13 @@ def read_bytes(path, retries=True):
 
     def _read():
         bk = _mock_backend()
+        t0 = _lat_start()
         if bk is not None:
             # The store fires its own open/read(/range-read) fault
             # points and resolves the newest committed generation.
-            return bk.get(path)
+            data = bk.get(path)
+            _lat_end(t0, "get")
+            return data
         faults.fault_point("open", path)
         with open(path, "rb") as f:
             data = f.read()
@@ -298,6 +318,7 @@ def read_bytes(path, retries=True):
         if action == "truncate":
             data = data[:max(0, len(data) // 2 - 1)]
         _backend.count("local", "get", "ok")
+        _lat_end(t0, "get")
         return data
 
     if retries:
@@ -399,13 +420,17 @@ def list_dir(path):
     serves a pre-put snapshot, which callers must treat as a discovery
     hint, never as record truth."""
     bk = _mock_backend()
+    t0 = _lat_start()
     if bk is not None:
-        return bk.list(path)
+        names = bk.list(path)
+        _lat_end(t0, "list")
+        return names
     try:
         names = sorted(os.listdir(path))
     except (FileNotFoundError, NotADirectoryError):
         return None
     _backend.count("local", "list", "ok")
+    _lat_end(t0, "list")
     return [n for n in names if ".tmp." not in n]
 
 
@@ -416,14 +441,17 @@ def remove(path):
     leave the object readable through the backend, silently resurrecting
     a withdrawn record."""
     bk = _mock_backend()
+    t0 = _lat_start()
     if bk is not None:
         bk.delete(path)
+        _lat_end(t0, "delete")
         return
     try:
         os.remove(path)
     except FileNotFoundError:
         pass
     _backend.count("local", "delete", "ok")
+    _lat_end(t0, "delete")
 
 
 def put_exclusive(path, data):
@@ -438,11 +466,14 @@ def put_exclusive(path, data):
     if bk is not None:
         if isinstance(data, str):
             data = data.encode("utf-8")
+        t0 = _lat_start()
         try:
             with_retries(lambda: bk.put_if_match(path, data, None),
                          desc="put_exclusive {}".format(path))
         except _backend.CASConflict:
+            _lat_end(t0, "cas-put")
             return "conflict"
+        _lat_end(t0, "cas-put")
         return "ok"
     atomic_write(path, data)
     return "ok"

@@ -1,0 +1,632 @@
+"""Diagnosis layer (PR 17): time-series telemetry (series segments,
+windowed rates), loader critical-path attribution with the bound
+verdict, the declarative alert-rules engine, spool retention/GC, the
+arm-time snapshot stamp, backend op latency histograms — and the
+contracts that hold it all together: byte-inertness (series +
+attribution armed vs off changes no batch byte), torn-tail tolerance,
+and crash-coherent series flushing on SIGTERM.
+"""
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+import golden_spool as gs  # noqa: E402
+
+from lddl_tpu import observability as obs  # noqa: E402
+from lddl_tpu.observability import (alerts, attribution, fleet,  # noqa: E402
+                                    series, tracing)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENVS = (fleet.ENV_FLEET_DIR, fleet.ENV_HOLDER, fleet.ENV_TTL,
+         fleet.ENV_INTERVAL, fleet.ENV_ROTATE_BYTES,
+         fleet.ENV_RETAIN_BYTES, fleet.ENV_RETAIN_AGE_S,
+         series.ENV_RING, "LDDL_TPU_METRICS_DIR", "LDDL_TPU_METRICS_RANK")
+
+
+def _scrub_env():
+    for name in _ENVS:
+        os.environ.pop(name, None)
+
+
+@pytest.fixture
+def clean_telemetry():
+    _scrub_env()
+    obs.registry().reset()
+    tracing._reset_for_tests()
+    fleet._reset_for_tests()
+    yield
+    _scrub_env()
+    obs.registry().reset()
+    tracing._reset_for_tests()
+    fleet._reset_for_tests()
+
+
+# ------------------------------------------------------------ series core
+
+
+def test_series_sample_diffs_and_key_roundtrip(clean_telemetry, tmp_path):
+    os.environ["LDDL_TPU_METRICS_DIR"] = str(tmp_path)
+    obs.inc("units_total", 3)
+    obs.inc("stage_seconds_total", 0.5, stage="decode")
+    obs.set_gauge("backlog_docs", 42.0)
+    obs.observe("op_latency_seconds", 0.01)
+    p1 = series.sample()
+    assert p1["d"]["units_total"] == 3
+    assert p1["d"]["stage_seconds_total{stage=decode}"] == 0.5
+    assert p1["g"]["backlog_docs"] == 42.0
+    assert p1["h"]["op_latency_seconds"]["n"] == 1
+    # No movement -> counters drop out of the next point entirely.
+    obs.set_gauge("backlog_docs", 40.0)
+    p2 = series.sample()
+    assert "units_total" not in p2.get("d", {})
+    assert p2["g"]["backlog_docs"] == 40.0
+    obs.inc("units_total", 2)
+    p3 = series.sample()
+    assert p3["d"]["units_total"] == 2  # delta, not cumulative
+    name, labels = series.split_key("stage_seconds_total{stage=decode}")
+    assert (name, labels) == ("stage_seconds_total", "stage=decode")
+    assert series.split_key("plain") == ("plain", "")
+
+
+def test_series_window_rollup_rates_gauges_histograms():
+    now = 1000.0
+    points = []
+    for i in range(10):
+        points.append({"wall": now - 90 + i * 10, "mono": i, "pid": 1,
+                       "d": {"units_total": 5.0},
+                       "g": {"backlog": 100.0 - i},
+                       "h": {"lat": {"n": 2, "s": 0.2,
+                                     "b": {"le_0.25": 2}}}})
+    roll = series.window_rollup(points, 60.0, now=now)
+    # 7 points inside [now-60, now]; 5 units each over a 60 s span.
+    assert roll["points"] == 7
+    assert roll["rates"]["units_total"] == pytest.approx(35.0 / 60.0)
+    g = roll["gauges"]["backlog"]
+    assert g["last"] < g["first"] and g["trend"] < 0
+    h = roll["histograms"]["lat"]
+    assert h["count"] == 14 and h["mean"] == pytest.approx(0.1)
+    assert h["p50"] == pytest.approx(0.25)
+    # Empty window stays well-formed.
+    empty = series.window_rollup(points, 60.0, now=now + 10_000)
+    assert empty["points"] == 0 and empty["rates"] == {}
+
+
+def test_percentile_from_buckets():
+    buckets = {"le_0.001": 10, "le_0.01": 80, "le_0.1": 10}
+    assert series.percentile_from_buckets(buckets, 0.5) == \
+        pytest.approx(0.01)
+    assert series.percentile_from_buckets(buckets, 0.99) == \
+        pytest.approx(0.1)
+    assert series.percentile_from_buckets({}, 0.5) is None
+
+
+def test_series_torn_tail_is_end_of_stream(clean_telemetry, tmp_path):
+    spool = tmp_path / ".telemetry" / "h1"
+    spool.mkdir(parents=True)
+    good = json.dumps({"wall": 1.0, "mono": 0.0, "pid": 7,
+                       "d": {"units_total": 4.0}})
+    (spool / "series-pid7.jsonl").write_text(good + "\n" + good[:11])
+    points, torn = series.read_series(str(tmp_path), "h1",
+                                      warn=lambda *a: None)
+    assert len(points) == 1 and torn == 1
+    assert points[0]["d"]["units_total"] == 4.0
+
+
+def test_series_flush_publishes_segments_via_heartbeat(
+        clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    spool = fleet.configure(root, holder_id="hostS", ttl=30, interval=3600)
+    obs.inc("units_total", 9)
+    fleet.heartbeat()
+    files = [n for n in sorted(os.listdir(spool))
+             if n.startswith(series.SEGMENT_PREFIX)]
+    assert files, sorted(os.listdir(spool))
+    points, torn = series.read_series(root, "hostS")
+    assert torn == 0
+    assert sum(p.get("d", {}).get("units_total", 0) for p in points) == 9
+
+
+# --------------------------------------------------- rotation + retention
+
+
+def test_event_spool_rotation_reads_seamlessly(clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    os.environ[fleet.ENV_ROTATE_BYTES] = "256"
+    spool = fleet.configure(root, holder_id="rot", ttl=30, interval=3600)
+    for i in range(40):
+        fleet.record("unit.claimed", unit="g{}".format(i), epoch=0,
+                     holder="rot")
+        fleet.flush_events()
+    names = sorted(os.listdir(spool))
+    segs = [n for n in names if n.startswith("events-pid")
+            and ".seg" in n]
+    assert segs, names  # rotation actually happened
+    # The reader merges base + rotated segments into one stream.
+    loaded = fleet.load_spool(root, "rot")
+    kinds = [ev["kind"] for ev in loaded["events"]]
+    assert kinds.count("unit.claimed") == 40
+    units = [ev["args"]["unit"] for ev in loaded["events"]]
+    assert units == ["g{}".format(i) for i in range(40)]
+
+
+def test_gc_spool_bounds_size_and_age_keeps_live(clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    os.environ[fleet.ENV_ROTATE_BYTES] = "256"
+    spool = fleet.configure(root, holder_id="gc", ttl=30, interval=3600)
+    for i in range(40):
+        fleet.record("unit.claimed", unit="g{}".format(i), epoch=0,
+                     holder="gc")
+        fleet.flush_events()
+    obs.inc("units_total", 1)
+    fleet.heartbeat()
+    segs = [n for n in sorted(os.listdir(spool)) if ".seg" in n]
+    assert segs
+    # Generous budgets: nothing is eligible yet.
+    assert fleet.gc_spool(spool) == 0
+    # Tiny byte budget: frozen segments go oldest-first, the live append
+    # targets and the open snapshot survive.
+    os.environ[fleet.ENV_RETAIN_BYTES] = "1"
+    live = {os.path.basename(fleet._ev_segment["path"] or ""),
+            os.path.basename(series._segment["path"] or "")}
+    removed = fleet.gc_spool(spool)
+    assert removed == len([n for n in segs if n not in live])
+    left = sorted(os.listdir(spool))
+    assert fleet._ev_segment["path"] is not None
+    assert os.path.basename(fleet._ev_segment["path"]) in left
+    assert any(n.startswith("snapshot-pid") for n in left)
+    # A closed snapshot from ANOTHER pid ages out; our own never does.
+    foreign = os.path.join(spool, "snapshot-pid99999.json")
+    with open(foreign, "w") as f:
+        json.dump({"holder": "gc", "pid": 99999, "closed": True}, f)
+    os.environ[fleet.ENV_RETAIN_AGE_S] = "0"
+    os.environ[fleet.ENV_RETAIN_BYTES] = str(1 << 30)
+    assert fleet.gc_spool(spool, now=time.time() + 10.0) >= 1
+    assert not os.path.exists(foreign)
+    assert any(n.startswith("snapshot-pid{}".format(os.getpid()))
+               for n in sorted(os.listdir(spool)))
+
+
+def test_arm_time_snapshot_stamps_before_first_heartbeat(
+        clean_telemetry, tmp_path):
+    """A run dying between configure() and the first heartbeat must
+    leave a start stamp, not an empty spool."""
+    root = str(tmp_path)
+    spool = fleet.configure(root, holder_id="stamp", ttl=30, interval=3600)
+    snaps = [n for n in sorted(os.listdir(spool))
+             if n.startswith("snapshot-pid")]
+    assert snaps, sorted(os.listdir(spool))
+    snap = fleet._read_json(os.path.join(spool, snaps[0]))
+    assert snap["closed"] is False and snap["started_wall"] is not None
+    # And the aggregator can age it into STALLED from the stamp alone.
+    report = fleet.aggregate(root, now=time.time() + 10_000.0)
+    assert report["hosts"]["stamp"]["stalled"]
+
+
+# ------------------------------------------------------------ attribution
+
+
+def test_attribution_verdict_rules_pure():
+    rep = attribution.from_stage_seconds(
+        {"batch_wait": 8.0, "step_gap": 2.0, "shard_read": 3.0,
+         "decode": 1.0})
+    assert rep["verdict"] == "input-bound" and rep["boundary"] == "loader"
+    assert rep["input_share"] == pytest.approx(0.8)
+    assert sum(rep["shares"].values()) == pytest.approx(1.0)
+    assert rep["top_stage"]["stage"] == "shard_read"
+    assert rep["shares"]["shard_read"] == pytest.approx(0.8 * 0.75)
+
+    rep = attribution.from_stage_seconds(
+        {"batch_wait": 1.0, "step_gap": 9.0})
+    assert rep["verdict"] == "compute-bound"
+    assert rep["shares"]["queue_wait"] == pytest.approx(0.1)
+    assert sum(rep["shares"].values()) == pytest.approx(1.0)
+
+    rep = attribution.from_stage_seconds(
+        {"batch_wait": 3.0, "step_gap": 7.0})
+    assert rep["verdict"] == "balanced"
+
+    # The prefetch boundary wins when present (outermost iterator).
+    rep = attribution.from_stage_seconds(
+        {"prefetch_wait": 5.0, "prefetch_gap": 5.0,
+         "batch_wait": 99.0, "step_gap": 1.0, "h2d": 2.0})
+    assert rep["boundary"] == "prefetch"
+    assert rep["input_share"] == pytest.approx(0.5)
+
+    assert attribution.from_stage_seconds({}) is None
+    assert attribution.from_stage_seconds({"decode": 1.0}) is None
+
+
+@pytest.fixture(scope="module")
+def ingested(tmp_path_factory):
+    """One tiny ingested dataset shared by the loader-path tests."""
+    from lddl_tpu.ingest import ingest_once
+    from lddl_tpu.preprocess import BertPretrainConfig, get_tokenizer
+
+    _scrub_env()
+    td = tmp_path_factory.mktemp("diag")
+    corpus = gs.build_corpus(str(td / "corpus"))
+    vocab = gs.build_vocab(str(td))
+    landing = str(td / "landing")
+    os.makedirs(os.path.join(landing, "source"))
+    shutil.copy(os.path.join(corpus, "source", "0.txt"),
+                os.path.join(landing, "source", "0.txt"))
+    root = str(td / "data")
+    tok = get_tokenizer(vocab_file=vocab)
+    cfg = BertPretrainConfig(max_seq_length=32, masking=False)
+    ingest_once(root, tok, landing=landing, config=cfg, num_shards=4,
+                seed=7, num_blocks=4)
+    return root, vocab
+
+
+def _batches(loader):
+    return [{k: np.asarray(v) for k, v in b.items()} for b in loader]
+
+
+def test_attribution_from_real_loader_with_known_step_sleep(
+        clean_telemetry, tmp_path, ingested):
+    """Instrumentation end-to-end: iterate the real loader with a known
+    consumer step (sleep), then the verdict must partition the observed
+    wall — shares summing to ~100%, step_gap covering the sleeps, and
+    every self-time stage the thread-mode path visits recorded."""
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+
+    root, vocab = ingested
+    os.environ["LDDL_TPU_METRICS_DIR"] = str(tmp_path / "m")
+    loader = get_bert_pretrain_data_loader(root, vocab_file=vocab,
+                                           batch_size=8, base_seed=5)
+    step_s = 0.02
+    t0 = time.perf_counter()
+    n = 0
+    for _ in loader:
+        time.sleep(step_s)
+        n += 1
+    wall = time.perf_counter() - t0
+    assert n > 0
+    rep = loader.attribution_snapshot()
+    assert rep is not None
+    assert rep["boundary"] == "loader"
+    assert sum(rep["shares"].values()) == pytest.approx(1.0)
+    # The observed wall is the full iteration wall minus the pre-first-
+    # batch setup; it must cover every sleep and stay under the total.
+    assert rep["wall_seconds"] >= n * step_s * 0.9
+    assert rep["wall_seconds"] <= wall + 0.001
+    stages = rep["stages_seconds"]
+    assert stages["step_gap"] >= n * step_s * 0.9
+    for stage in ("shard_read", "decode", "collate"):
+        assert stages.get(stage, 0.0) > 0.0, (stage, stages)
+    # snapshot() published the verdict gauges for the fleet rollup.
+    snap = obs.registry().snapshot()
+    assert attribution.VERDICT_GAUGE in snap
+    assert attribution.INPUT_SHARE_GAUGE in snap
+
+
+def test_series_and_attribution_are_byte_inert(clean_telemetry, tmp_path,
+                                               ingested):
+    """The PR's inertness pin: telemetry off vs armed (metrics + fleet +
+    tiny rotation bound, so series/attribution instrumentation AND spool
+    rotation all actually run) yields an identical batch stream."""
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+
+    root, vocab = ingested
+    off = _batches(get_bert_pretrain_data_loader(
+        root, vocab_file=vocab, batch_size=8, base_seed=5))
+
+    _scrub_env()
+    obs.registry().reset()
+    fleet._reset_for_tests()
+    out = str(tmp_path / "armed")
+    os.environ[fleet.ENV_ROTATE_BYTES] = "512"
+    fleet.configure(out, holder_id="inert", ttl=30, interval=3600)
+    on = _batches(get_bert_pretrain_data_loader(
+        root, vocab_file=vocab, batch_size=8, base_seed=5))
+    fleet.heartbeat(closed=True)
+
+    assert len(off) == len(on) and len(off) > 0
+    for x, y in zip(off, on):
+        assert sorted(x) == sorted(y)
+        for k in x:
+            np.testing.assert_array_equal(x[k], y[k], err_msg=k)
+    # And the armed run actually produced series + attribution telemetry.
+    points, _ = series.read_series(out, "inert")
+    keys = {k for p in points for k in p.get("d", {})}
+    assert any(k.startswith(attribution.STAGE_METRIC) for k in keys)
+
+
+# ------------------------------------------------------------ alert rules
+
+
+def _write_rules(path, rules):
+    with open(path, "w") as f:
+        json.dump({"rules": rules}, f)
+    return path
+
+
+def _mk_series(root, holder, points):
+    d = os.path.join(root, ".telemetry", holder)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "series-pid1.jsonl"), "w") as f:
+        for p in points:
+            f.write(json.dumps(p) + "\n")
+
+
+def test_alert_rules_validation():
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "r.json")
+        for bad in (
+                [{"type": "threshold", "metric": "m", "value": 1}],  # name
+                [{"name": "a", "type": "nope", "metric": "m",
+                  "value": 1}],
+                [{"name": "a", "type": "threshold", "metric": "m",
+                  "op": "~", "value": 1}],
+                [{"name": "a", "type": "threshold", "metric": "m"}],
+                [{"name": "a", "type": "threshold", "metric": "m",
+                  "value": 1}] * 2,  # duplicate names
+                [{"name": "a", "type": "threshold", "value": 1}],  # metric
+        ):
+            _write_rules(p, bad)
+            with pytest.raises(ValueError):
+                alerts.load_rules(p)
+        _write_rules(p, [{"name": "ok", "metric": "m", "value": 5}])
+        (rule,) = alerts.load_rules(p)
+        assert rule["type"] == "threshold" and rule["op"] == ">"
+
+
+def test_alert_threshold_fire_resolve_persists_state(tmp_path):
+    root = str(tmp_path)
+    rules = [{"name": "backlog", "type": "threshold",
+              "metric": "totals.counters.backlog", "op": ">", "value": 10}]
+    report = {"totals": {"counters": {"backlog": 50}}, "hosts": {}}
+    eng = alerts.AlertEngine(rules, root)
+    res = eng.evaluate(report=report, now=100.0)
+    assert res["firing"] == ["backlog"]
+    assert [t["kind"] for t in res["transitions"]] == ["alert.fired"]
+    # Second pass, still firing: no new transition, since_wall sticks.
+    res2 = eng.evaluate(report=report, now=110.0)
+    assert res2["transitions"] == []
+    assert res2["alerts"][0]["since_wall"] == 100.0
+    # A NEW engine (one-shot CLI pattern) sees the persisted state and
+    # journals the resolve.
+    report["totals"]["counters"]["backlog"] = 3
+    eng2 = alerts.AlertEngine(alerts.load_rules(_write_rules(
+        os.path.join(root, "r.json"), rules)), root)
+    res3 = eng2.evaluate(report=report, now=120.0)
+    assert res3["firing"] == []
+    assert [t["kind"] for t in res3["transitions"]] == ["alert.resolved"]
+    events, torn = alerts.read_alert_events(root)
+    assert torn == 0
+    assert [(e["kind"], e["args"]["rule"]) for e in events] == \
+        [("alert.fired", "backlog"), ("alert.resolved", "backlog")]
+
+
+def test_alert_wildcard_report_path(tmp_path):
+    rules = [{"name": "worst-beat", "type": "threshold",
+              "metric": "hosts.*.heartbeat_age_s", "op": ">", "value": 60}]
+    report = {"hosts": {"a": {"heartbeat_age_s": 5.0},
+                        "b": {"heartbeat_age_s": 120.0}}}
+    res = alerts.AlertEngine(rules, str(tmp_path)).evaluate(
+        report=report, now=0.0)
+    assert res["firing"] == ["worst-beat"]
+    assert res["alerts"][0]["value"] == 120.0
+
+
+def test_alert_rate_rule_windows(tmp_path):
+    root = str(tmp_path)
+    now = 1000.0
+    # 10 units at t=950, 10 at t=990: rate depends on the window.
+    _mk_series(root, "h1", [
+        {"wall": 950.0, "mono": 0, "pid": 1, "d": {"units_total": 10.0}},
+        {"wall": 990.0, "mono": 1, "pid": 1, "d": {"units_total": 10.0}},
+    ])
+    report = {"hosts": {}, "totals": {"counters": {}}}
+    fast = [{"name": "r", "type": "rate", "metric": "units_total",
+             "window_s": 60, "op": ">", "value": 0.3}]
+    res = alerts.AlertEngine(fast, root).evaluate(report=report, now=now)
+    assert res["firing"] == ["r"]  # 20 units / 40s span = 0.5/s
+    narrow = [{"name": "r", "type": "rate", "metric": "units_total",
+               "window_s": 20, "op": ">", "value": 0.3}]
+    res = alerts.AlertEngine(narrow, root).evaluate(report=report, now=now)
+    # Only the t=990 point is inside; a single point's span floors at
+    # the 1 s heartbeat-ish minimum, so 10 units read as 10/s.
+    assert res["alerts"][0]["value"] == pytest.approx(10.0)
+    cold = [{"name": "r", "type": "rate", "metric": "units_total",
+             "window_s": 60, "op": ">", "value": 0.3}]
+    res = alerts.AlertEngine(cold, root).evaluate(
+        report=report, now=now + 10_000)
+    assert res["firing"] == []  # window empty -> rate 0
+
+
+def test_alert_rate_tolerates_torn_series_tail(tmp_path):
+    root = str(tmp_path)
+    d = os.path.join(root, ".telemetry", "h1")
+    os.makedirs(d)
+    line = json.dumps({"wall": 990.0, "mono": 0, "pid": 1,
+                       "d": {"units_total": 30.0}})
+    with open(os.path.join(d, "series-pid1.jsonl"), "w") as f:
+        f.write(line + "\n" + line[:17])  # torn tail = end of stream
+    rules = [{"name": "r", "type": "rate", "metric": "units_total",
+              "window_s": 60, "op": ">", "value": 0.1}]
+    res = alerts.AlertEngine(rules, root).evaluate(
+        report={"hosts": {}}, now=1000.0, warn=lambda *a: None)
+    assert res["firing"] == ["r"]
+    assert res["alerts"][0].get("error") is None
+
+
+def test_alert_absence_fires_then_resolves(clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    rules = [{"name": "no-loader", "type": "absence",
+              "metric": "loader_batches_total"}]
+    report = {"hosts": {}}
+    eng = alerts.AlertEngine(rules, root)
+    res = eng.evaluate(report=report, now=100.0)
+    assert res["firing"] == ["no-loader"]
+    # The metric appearing in a holder snapshot resolves it.
+    spool = fleet.configure(root, holder_id="h1", ttl=30, interval=3600)
+    assert spool
+    obs.inc("loader_batches_total", 5)
+    fleet.heartbeat()
+    res = eng.evaluate(report=report, now=110.0)
+    assert res["firing"] == []
+    assert [t["kind"] for t in res["transitions"]] == ["alert.resolved"]
+    # windowed absence: no series point inside the window re-fires it.
+    windowed = [{"name": "no-loader", "type": "absence",
+                 "metric": "loader_batches_total", "window_s": 30}]
+    res = alerts.AlertEngine(windowed, root).evaluate(
+        report=report, now=time.time() + 10_000.0)
+    assert res["firing"] == ["no-loader"]
+
+
+def test_alert_bad_metric_is_error_not_crash(tmp_path):
+    rules = [{"name": "weird", "type": "threshold",
+              "metric": "no.such.path", "op": ">", "value": 1}]
+    res = alerts.AlertEngine(rules, str(tmp_path)).evaluate(
+        report={"hosts": {}}, now=0.0)
+    # Unresolvable threshold metric = not firing (absence is the rule
+    # type that alarms on missing data).
+    assert res["firing"] == [] and res["alerts"][0]["value"] is None
+
+
+def test_alerts_fired_counter_increments(clean_telemetry, tmp_path):
+    root = str(tmp_path)
+    os.environ["LDDL_TPU_METRICS_DIR"] = str(tmp_path / "m")
+    rules = [{"name": "hot", "type": "threshold",
+              "metric": "totals.counters.x", "op": ">", "value": 1}]
+    alerts.AlertEngine(rules, root).evaluate(
+        report={"totals": {"counters": {"x": 5}}, "hosts": {}}, now=0.0)
+    snap = obs.registry().snapshot()
+    assert snap[alerts.FIRED_COUNTER]["values"]["rule=hot"] == 1
+
+
+# --------------------------------------------------- status CLI + rollup
+
+
+def test_pipeline_status_window_alerts_and_backend(clean_telemetry,
+                                                   tmp_path, capsys):
+    from tools import pipeline_status
+
+    root = str(tmp_path)
+    fleet.configure(root, holder_id="cli", ttl=30, interval=3600)
+    obs.inc("elastic_units_completed_total", 4, phase="gather")
+    stage = attribution.stage_counter()
+    stage.inc(0.6, stage="shard_read")
+    stage.inc(0.8, stage="batch_wait")
+    stage.inc(0.2, stage="step_gap")
+    fleet.heartbeat(closed=True)
+
+    rules = _write_rules(os.path.join(root, "rules.json"), [
+        {"name": "trip", "type": "threshold",
+         "metric": "totals.counters.units_completed", "op": "<",
+         "value": 100},
+    ])
+    rc = pipeline_status.main([root, "--json", "--window", "120",
+                               "--alerts", rules])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2  # healthy, but the tripped alert forces exit 2
+    assert doc["health"]["ok"]
+    assert doc["alerts"]["firing"] == ["trip"]
+    assert doc["attribution"]["verdict"] == "input-bound"
+    assert any(k.startswith("backend_ops_total")
+               for k in doc["window"]["rates"])
+    assert doc["backend"]["ops"]  # snapshot writes counted put ops
+    assert any(lbl.startswith("backend=")
+               for lbl in doc["backend"]["latency"])
+    win = doc["hosts"]["cli"]["window"]
+    assert win["rates"].get(
+        "loader_stage_seconds_total{stage=shard_read}") == \
+        pytest.approx(0.6 / win["span_s"])
+
+    # Resolving rule -> exit 0, resolve journaled as a fleet-style event.
+    _write_rules(rules, [
+        {"name": "trip", "type": "threshold",
+         "metric": "totals.counters.units_completed", "op": "<",
+         "value": 0}])
+    rc = pipeline_status.main([root, "--json", "--alerts", rules])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["alerts"]["firing"] == []
+    events, _ = alerts.read_alert_events(root)
+    assert [e["kind"] for e in events] == ["alert.fired",
+                                           "alert.resolved"]
+    assert all("wall" in e and "mono" in e and "pid" in e for e in events)
+
+    # Text mode renders the verdict, sparkline window and alert rows.
+    rc = pipeline_status.main([root, "--window", "120"])
+    text = capsys.readouterr().out
+    assert rc == 0
+    assert "loader bound verdict: input-bound" in text
+    assert "window: last 120s" in text
+
+
+def test_backend_latency_histogram_from_io_ops(clean_telemetry, tmp_path):
+    from lddl_tpu.resilience import io as rio
+
+    os.environ["LDDL_TPU_METRICS_DIR"] = str(tmp_path / "m")
+    p = str(tmp_path / "f.bin")
+    rio.atomic_write(p, b"payload")
+    assert rio.read_bytes(p) == b"payload"
+    assert rio.list_dir(str(tmp_path)) is not None
+    rio.remove(p)
+    snap = obs.registry().snapshot()
+    lat = snap["backend_op_latency_seconds"]
+    assert lat["type"] == "histogram"
+    ops = {lbl.split("op=")[1].split(",")[0] for lbl in lat["values"]}
+    assert {"put", "get", "list", "delete"} <= ops
+    for stats in lat["values"].values():
+        assert stats["count"] >= 1 and stats["sum"] >= 0.0
+
+
+# ------------------------------------------------ SIGTERM series flushing
+
+_SIGTERM_SERIES_DRIVER = """
+import os, sys, time
+root = sys.argv[1]
+os.environ["LDDL_TPU_FLEET_DIR"] = root
+os.environ["LDDL_TPU_FLEET_HOLDER"] = "sender"
+os.environ["LDDL_TPU_FLEET_INTERVAL_S"] = "3600"  # only exit paths flush
+from lddl_tpu.observability import fleet
+import lddl_tpu.observability as obs
+fleet.ensure_started()
+obs.inc("units_total", 7)
+from lddl_tpu.observability import attribution
+attribution.stage_counter().inc(0.25, stage="decode")
+print("READY", flush=True)
+time.sleep(120)
+"""
+
+
+def test_sigterm_flushes_series_segments(tmp_path):
+    """Series history must ride the same abnormal-exit flush as the
+    snapshot: with the heartbeat parked for an hour, only the SIGTERM
+    handler can have published these points."""
+    root = str(tmp_path)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    for name in _ENVS:
+        env.pop(name, None)
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _SIGTERM_SERIES_DRIVER, root], env=env,
+        cwd=REPO_ROOT, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    assert proc.stdout.readline().strip() == "READY"
+    proc.send_signal(signal.SIGTERM)
+    out = proc.communicate(timeout=60)[0]
+    assert proc.returncode == -signal.SIGTERM, out
+    points, torn = series.read_series(root, "sender")
+    assert torn == 0
+    deltas = {}
+    for p in points:
+        for k, v in p.get("d", {}).items():
+            deltas[k] = deltas.get(k, 0.0) + v
+    assert deltas.get("units_total") == 7
+    assert deltas.get(
+        attribution.STAGE_METRIC + "{stage=decode}") == pytest.approx(0.25)

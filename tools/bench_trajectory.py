@@ -178,6 +178,28 @@ def load_loader_bench(repo_root):
     return out
 
 
+def load_live_rates(root, window_s):
+    """Windowed per-metric rates from the time-series telemetry segments
+    under ``<root>/.telemetry/`` (summed across hosts) — the live
+    counterpart to the committed artifact series, so a trajectory check
+    can be run against a fleet mid-flight, not only after artifacts
+    land. None when the root has no telemetry."""
+    try:
+        from lddl_tpu.observability import fleet
+        from lddl_tpu.observability import series as ts
+    except ImportError:
+        return None
+    rates = {}
+    for h in fleet.list_holders(root):
+        points, _ = ts.read_series(root, h)
+        roll = ts.window_rollup(points, window_s)
+        for key, r in roll["rates"].items():
+            rates[key] = rates.get(key, 0.0) + r
+    if not rates:
+        return None
+    return {"window_s": window_s, "rates": rates}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -188,6 +210,11 @@ def main(argv=None):
                     help="directory holding the BENCH_r*.json artifacts")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable trajectory")
+    ap.add_argument("--series-dir", default=None, metavar="DIR",
+                    help="also read live time-series telemetry under "
+                         "DIR/.telemetry and report windowed rates")
+    ap.add_argument("--window", type=float, default=300.0,
+                    help="--series-dir trailing window (seconds)")
     args = ap.parse_args(argv)
     series = normalize(load_bench_series(args.repo_root))
     result = {
@@ -197,6 +224,8 @@ def main(argv=None):
         "sink_overlap": load_sink_overlap(args.repo_root),
         "coordination": load_coordination(args.repo_root),
     }
+    if args.series_dir:
+        result["live_rates"] = load_live_rates(args.series_dir, args.window)
     if args.json:
         print(json.dumps(result, indent=2, sort_keys=True))
         return 0
@@ -277,6 +306,17 @@ def main(argv=None):
                       scale.get("decisions_total"),
                       scale.get("backlog_slo_docs"),
                       scale.get("helper_joined_generation")))
+    live = result.get("live_rates")
+    if live:
+        print("live rates (last {:.0f}s from {}):".format(
+            live["window_s"], args.series_dir))
+        print(_table(
+            [[k, "{:.3g}/s".format(v)]
+             for k, v in sorted(live["rates"].items())],
+            ["metric", "rate"]))
+    elif args.series_dir:
+        print("no series telemetry found under {}/.telemetry".format(
+            args.series_dir))
     return 0
 
 

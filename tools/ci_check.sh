@@ -89,6 +89,17 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
         echo "ci_check: backend smoke FAILED — local/mock divergence or crash" >&2
         exit 1
     fi
+    # Diagnosis-surface smoke: a tiny fleet-armed preprocess -> balance
+    # -> load run, then pipeline_status driven as an operator would.
+    # GATING: `--json --window` must parse with windowed series rates
+    # and a loader bound-verdict, a tripped alert rule must force exit
+    # code 2, and the relaxed rules file must journal the resolve.
+    if JAX_PLATFORMS=cpu python benchmarks/status_smoke.py; then
+        echo "ci_check: pipeline_status diagnosis smoke OK"
+    else
+        echo "ci_check: status smoke FAILED — attribution/window/alert contract broken" >&2
+        exit 1
+    fi
 fi
 
 # Opt-in native-engine smoke: builds the C++ engine from source and runs

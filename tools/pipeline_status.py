@@ -18,10 +18,20 @@ cluster rollups with explicit health verdicts:
 - the service is **WEDGED** when live hosts and pending work exist but
   the journal/ledger has made no progress inside the wedge window.
 
-Exit status: 0 when healthy, 2 when any verdict fired (``--json`` too,
-so CI can gate on it). ``--merge-trace out.json`` additionally writes
-one clock-aligned Chrome trace spanning every host (open in Perfetto);
-``tools/trace_summary.py --merge`` does the same plus summary tables.
+``--window SECONDS`` additionally reads the time-series segments each
+host's heartbeat spools (series-pid*.jsonl) and renders windowed rates
+with sparklines and gauge trends — "what is happening NOW", not lifetime
+averages. ``--alerts rules.json`` evaluates a declarative alert-rules
+file (threshold / rate-over-window / absence; see
+lddl_tpu/observability/alerts.py for the schema) against the same
+rollup; firing/resolving transitions are journaled under
+``.telemetry/`` so one-shot invocations see them too.
+
+Exit status: 0 when healthy, 2 when any verdict fired OR any alert rule
+is firing (``--json`` too, so CI can gate on it). ``--merge-trace
+out.json`` additionally writes one clock-aligned Chrome trace spanning
+every host (open in Perfetto); ``tools/trace_summary.py --merge`` does
+the same plus summary tables.
 
 All wall-clock reads happen inside ``fleet.aggregate`` (observability is
 the one layer allowlisted for them); this tool only formats the report.
@@ -60,6 +70,58 @@ def _host_status(st):
     if st["closed"]:
         return "closed"
     return "live"
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width=24):
+    """A sparkline over a value sequence, resampled to ``width`` bins by
+    summing (the inputs are deltas, so summing preserves totals)."""
+    if not values:
+        return ""
+    if len(values) > width:
+        bins = [0.0] * width
+        for i, v in enumerate(values):
+            bins[i * width // len(values)] += v
+        values = bins
+    hi = max(values)
+    if hi <= 0:
+        return _SPARK_CHARS[0] * len(values)
+    return "".join(
+        _SPARK_CHARS[min(len(_SPARK_CHARS) - 1,
+                         int(v / hi * (len(_SPARK_CHARS) - 1) + 0.5))]
+        for v in values)
+
+
+def _trend_arrow(trend):
+    if trend is None:
+        return ""
+    if trend > 0:
+        return "↑"
+    if trend < 0:
+        return "↓"
+    return "→"
+
+
+def _window_sections(report):
+    """(rate_rows, gauge_rows) for the --window tables, merged across
+    hosts (each row keeps its host column so a skewed host stands out)."""
+    rate_rows, gauge_rows = [], []
+    for name in sorted(report["hosts"]):
+        win = report["hosts"][name].get("window")
+        if not win:
+            continue
+        for key in sorted(win["rates"]):
+            deltas = [dv for _, dv in win["deltas"].get(key, ())]
+            rate_rows.append([name, key,
+                              "{:.3g}/s".format(win["rates"][key]),
+                              _spark(deltas)])
+        for key in sorted(win["gauges"]):
+            g = win["gauges"][key]
+            gauge_rows.append([name, key, "{:.4g}".format(g["last"]),
+                               _trend_arrow(g.get("trend"))])
+    return rate_rows, gauge_rows
 
 
 def format_report(report):
@@ -135,13 +197,62 @@ def format_report(report):
                 [[k, n] for k, n in sorted(events.items(),
                                            key=lambda kv: -kv[1])],
                 ["lifecycle event", "count"]))
+    attr = report.get("attribution")
+    if attr:
+        from lddl_tpu.observability import attribution as attr_mod
+        out.append("")
+        out.append(attr_mod.format_report(attr))
+    backend = report.get("backend") or {}
+    if backend.get("ops") or backend.get("latency"):
+        lat = backend.get("latency") or {}
+        rows = []
+        for label, n in sorted(backend.get("ops", {}).items()):
+            stats = lat.get(_strip_outcome(label), {})
+            rows.append([label, n,
+                         "{:.2f}ms".format(stats["mean"] * 1e3)
+                         if stats.get("mean") is not None else "-",
+                         "{:.2f}ms".format(stats["max"] * 1e3)
+                         if stats.get("max") is not None else "-"])
+        out.append("")
+        out.append(_table(rows, ["backend op", "count", "mean", "max"]))
+    rate_rows, gauge_rows = _window_sections(report)
+    if rate_rows or gauge_rows:
+        out.append("")
+        out.append("window: last {:.0f}s".format(
+            report.get("window", {}).get("window_s", 0.0)))
+        if rate_rows:
+            out.append(_table(rate_rows, ["host", "metric", "rate",
+                                          "trend"]))
+        if gauge_rows:
+            out.append(_table(gauge_rows, ["host", "gauge", "last", ""]))
+    alerts = report.get("alerts")
+    if alerts:
+        out.append("")
+        for a in alerts["alerts"]:
+            state = "FIRING" if a["firing"] else (
+                "error" if a.get("error") else "ok")
+            detail = a.get("error") or "value={}".format(
+                "-" if a["value"] is None else "{:.4g}".format(a["value"])
+                if isinstance(a["value"], float) else a["value"])
+            out.append("alert {:<24s} [{}] {}".format(
+                a["name"], state, detail))
     out.append("")
     if health["verdicts"]:
         for v in health["verdicts"]:
             out.append("!! {}".format(v))
     else:
         out.append("no health verdicts fired")
+    if alerts and alerts["firing"]:
+        out.append("!! alert(s) firing: {}".format(
+            ", ".join(alerts["firing"])))
     return "\n".join(out)
+
+
+def _strip_outcome(label):
+    """backend_ops_total labels carry an outcome the latency histogram
+    does not — drop it so the two join on {backend,op}."""
+    return ",".join(part for part in label.split(",")
+                    if not part.startswith("outcome="))
 
 
 def run_once(args):
@@ -149,11 +260,16 @@ def run_once(args):
     from lddl_tpu.resilience import backend as storage
 
     report = fleet.aggregate(args.dir, stall_ttl=args.stall_ttl,
-                             wedge_window=args.wedge_window)
+                             wedge_window=args.wedge_window,
+                             window=args.window)
     # The backend this process would coordinate through (env-selected;
     # chaos/CI runs export LDDL_TPU_STORAGE_BACKEND into the whole
     # fleet, so the operator's status probe names the same store).
     report["storage_backend"] = storage.active_name()
+    if args.alerts:
+        from lddl_tpu.observability import alerts as alerts_mod
+        report["alerts"] = alerts_mod.evaluate_file(
+            args.dir, args.alerts, report=report)
     if args.merge_trace:
         events, lanes = fleet.merge_traces(args.dir)
         with open(args.merge_trace, "w", encoding="utf-8") as f:
@@ -169,7 +285,8 @@ def run_once(args):
         if args.merge_trace:
             print("merged trace: {} ({} events, {} lane(s))".format(
                 args.merge_trace, len(events), len(lanes)))
-    return 0 if report["health"]["ok"] else 2
+    firing = bool(report.get("alerts", {}).get("firing"))
+    return 0 if report["health"]["ok"] and not firing else 2
 
 
 def main(argv=None):
@@ -193,6 +310,14 @@ def main(argv=None):
                     help="no-progress window (s) after which live hosts "
                          "with pending work are declared wedged "
                          "(default: max(4*stall_ttl, 120))")
+    ap.add_argument("--window", type=float, default=None, metavar="SECONDS",
+                    help="also read the series segments and report "
+                         "windowed rates, sparklines, and gauge trends "
+                         "over the trailing SECONDS")
+    ap.add_argument("--alerts", default=None, metavar="RULES_FILE",
+                    help="evaluate a JSON/TOML alert-rules file against "
+                         "the rollup; any firing rule forces exit 2 and "
+                         "transitions are journaled under .telemetry/")
     ap.add_argument("--merge-trace", default=None, metavar="OUT.json",
                     help="also write one clock-aligned Chrome trace "
                          "merging every host spool (open in Perfetto)")
