@@ -160,7 +160,9 @@ def host_calibration():
 def main():
     target_mb = float(os.environ.get("BENCH_MB", "24"))
     variant_mb = float(os.environ.get("BENCH_VARIANT_MB", "6"))
-    workers = os.cpu_count()  # matches the CLI default (--local-workers 0)
+    from lddl_tpu.utils.cpus import usable_cpu_count
+    workers = usable_cpu_count()  # matches the CLI default
+    # (--local-workers 0): affinity-aware, not os.cpu_count()
     tmp = tempfile.mkdtemp(prefix="lddl_bench_")
     try:
         from lddl_tpu.preprocess import build_wordpiece_vocab, get_tokenizer
@@ -247,6 +249,10 @@ def main():
             "config": {
                 "num_workers": workers,
                 "host_cpu_count": os.cpu_count(),
+                "nproc": usable_cpu_count(),
+                "host_can_show_scaling": usable_cpu_count() >= 2,
+                "native_threads_env":
+                    os.environ.get("LDDL_TPU_NATIVE_THREADS"),
                 "headline_runs_mb_per_s": [round(r, 4) for r in runs],
                 "host_calibration_s": host_calibration(),
                 "corpus_mb": round(main_bytes / 1024 / 1024, 2),

@@ -27,6 +27,7 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 import bench  # noqa: E402
+from lddl_tpu.utils.cpus import usable_cpu_count  # noqa: E402
 
 
 def _parquet_digests(out_dir):
@@ -104,7 +105,7 @@ def main():
 
         report = {"ops_per_unit": {}, "ops_per_unit_ratio": None,
                   "units": {}, "wall_s": {},
-                  "host_can_show_scaling": (os.cpu_count() or 1) >= 4}
+                  "host_can_show_scaling": usable_cpu_count() >= 2}
         digests = {}
         for mode, env_extra in (("legacy", {"LDDL_TPU_COORD_LEGACY": "1"}),
                                 ("batched", {})):

@@ -36,6 +36,8 @@ import tempfile
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from lddl_tpu.utils.cpus import usable_cpu_count  # noqa: E402
+
 # (masking, bin_size, schema_version, pack_seq_length, pack_max_per_row)
 # per buildable dataset. The v1 datasets keep their historical names so
 # rows stay comparable across bench rounds; the *_v2 twins hold the same
@@ -90,7 +92,7 @@ def _build_dataset(tmp, mb, which=None):
                                       schema_version=schema),
             num_blocks=8, sample_ratio=1.0, seed=12345, bin_size=bin_size,
             pack_seq_length=pack_L, pack_max_per_row=pack_P,
-            num_workers=os.cpu_count())
+            num_workers=usable_cpu_count())
         balance_shards(pre, bal, 8)
         datasets[name] = bal
     return datasets, vocab
@@ -396,7 +398,7 @@ def main():
             w4 = results.get("static_binned_w4")
             if w1 and w4:
                 key = "sustained_samples_per_s"
-                multicore = (os.cpu_count() or 1) >= 4
+                multicore = usable_cpu_count() >= 4
                 wins = w4[key] > w1[key]
                 scaling = {
                     "metric": key,
@@ -413,11 +415,12 @@ def main():
                 "corpus_mb": args.mb,
                 "batch_size": args.batch_size,
                 "cpu_count": os.cpu_count(),
+                "usable_cpus": usable_cpu_count(),
                 # Stamped next to every scaling number (ISSUE 15): a
                 # < 4-core bench host cannot exhibit worker scaling, so
                 # readers of the artifact must not treat flat ratios
                 # from such a host as a regression.
-                "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+                "host_can_show_scaling": usable_cpu_count() >= 2,
                 "runs_per_config": args.runs,
                 "smoke": args.smoke,
                 "worker_scaling": scaling,

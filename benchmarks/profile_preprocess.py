@@ -32,6 +32,7 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 import bench  # noqa: E402  (repo-root bench.py: corpus + vocab helpers)
+from lddl_tpu.utils.cpus import usable_cpu_count  # noqa: E402
 
 
 _SINKS = (
@@ -55,6 +56,90 @@ def _sink_of(func):
         if any(n in key for n in needles):
             return sink
     return "other_python"
+
+
+def _fresh_native(tokenizer):
+    """A NativeTokenizer mirroring ``tokenizer``'s vocab, or None."""
+    from lddl_tpu import native
+    from lddl_tpu.preprocess.bert import TokenizerInfo
+    if not native.available():
+        return None
+    info = TokenizerInfo(tokenizer)
+    nat = info.native_tokenizer()
+    if nat is None:
+        return None
+    # Rebuild fresh from the pickled ctor args so each measurement starts
+    # with cold memo caches (no cross-thread-count warm-up bias).
+    cls, args = nat.__reduce__()
+    return cls(*args)
+
+
+def native_thread_bench(tokenizer, texts, seconds=1.0):
+    """Standalone tokenize MB/s at thread counts {1, 2, 4, nproc}.
+
+    Informational on a 1-core host (the pool runs but cannot speed up);
+    on >= 2 usable cores the 2-thread row is the scaling criterion
+    (tokenize >= 1.6x at 2 threads, ISSUE 18). A fresh tokenizer per
+    count keeps the word-memo warm-up identical across rows."""
+    import time as _time
+    data = [t.encode("utf-8") for t in texts]
+    nbytes = float(sum(len(d) for d in data))
+    rows = {}
+    for nt in sorted({1, 2, 4, usable_cpu_count()}):
+        nat = _fresh_native(tokenizer)
+        if nat is None:
+            return None
+        nat.set_threads(nt)
+        nat.tokenize_docs(data[:8])  # pool + table warm-up
+        t0 = _time.perf_counter()
+        reps = 0
+        elapsed = 0.0
+        while elapsed < seconds:
+            nat.tokenize_docs(data)
+            reps += 1
+            elapsed = _time.perf_counter() - t0
+        rows[str(nt)] = round(nbytes * reps / elapsed / 1e6, 2)
+    speedup_2t = (round(rows["2"] / rows["1"], 3)
+                  if "1" in rows and "2" in rows and rows["1"] else None)
+    return {
+        "tokenize_mb_per_s_by_threads": rows,
+        "speedup_2_threads": speedup_2t,
+        "meets_2t_criterion": (None if usable_cpu_count() < 2
+                               or speedup_2t is None
+                               else speedup_2t >= 1.6),
+    }
+
+
+def sentence_memo_bench(tokenizer, texts, dup=8):
+    """MB/s on a bucket whose sentences repeat ``dup``x vs a unique
+    stream — the in-kernel sentence-level token-run memo (ISSUE 18
+    satellite) should make the repeated bucket tokenize faster per byte;
+    the ratio is that win (1.0 = no memo effect)."""
+    import time as _time
+    base = texts[:max(1, len(texts) // dup)]
+    repeated = [t.encode("utf-8") for t in base] * dup
+    unique = [t.encode("utf-8") for t in texts[:len(repeated)]]
+
+    def mbps(data):
+        nat = _fresh_native(tokenizer)
+        if nat is None:
+            return None
+        nat.tokenize_docs(data[:8])
+        nbytes = float(sum(len(d) for d in data))
+        t0 = _time.perf_counter()
+        reps = 0
+        elapsed = 0.0
+        while elapsed < 0.5:
+            nat.tokenize_docs(data)
+            reps += 1
+            elapsed = _time.perf_counter() - t0
+        return nbytes * reps / elapsed / 1e6
+    r, u = mbps(repeated), mbps(unique)
+    if r is None or u is None or not u:
+        return None
+    return {"repeated_mb_per_s": round(r, 2),
+            "unique_mb_per_s": round(u, 2),
+            "memo_speedup": round(r / u, 3)}
 
 
 def main():
@@ -160,7 +245,7 @@ def main():
             # measurement host had the cores to show parallel scaling
             # (a 1-core CI box profiles attribution fine but its MB/s
             # must not be read as a multi-worker claim).
-            "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+            "host_can_show_scaling": usable_cpu_count() >= 2,
             "sinks_tottime_s": {
                 k: {"s": round(v, 3), "share_pct": round(100 * v / total, 1)}
                 for k, v in sorted(sinks.items(), key=lambda kv: -kv[1])},
@@ -190,6 +275,12 @@ def main():
         }
         if previous is not None:
             payload["previous"] = previous
+        # Per-thread-count standalone tokenize MB/s (informational on a
+        # 1-core host; the 2-thread criterion row on multi-core) and the
+        # sentence-memo win on repeated-sentence buckets.
+        payload["native_thread_scaling"] = native_thread_bench(
+            tokenizer, sample)
+        payload["sentence_memo"] = sentence_memo_bench(tokenizer, sample)
         with open(ns.out, "w") as f:
             json.dump(payload, f, indent=1)
         print("wrote", ns.out)

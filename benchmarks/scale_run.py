@@ -51,6 +51,7 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
 import bench
+from lddl_tpu.utils.cpus import usable_cpu_count
 
 
 def _env():
@@ -352,7 +353,7 @@ def phase_coordination(tmp, vocab, coord_corpus, payload, n_hosts=3,
             "steal_latency_s_median": round(lats[len(lats) // 2], 2),
             "steal_latency_s_max": round(lats[-1], 2),
         },
-        "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+        "host_can_show_scaling": usable_cpu_count() >= 2,
     }
     print(payload["phases"]["coordination_cost"], flush=True)
 
@@ -430,7 +431,7 @@ def phase_autoscale(tmp, vocab, coord_corpus, payload):
             ev.get("kind") == "generation.joined" for ev in events),
         "status_exit": status.returncode,
         "status_event_counts": ev_counts,
-        "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+        "host_can_show_scaling": usable_cpu_count() >= 2,
     }
     print(payload["phases"]["autoscale_episode"], flush=True)
 
@@ -456,7 +457,8 @@ def main():
     os.makedirs(tmp, exist_ok=True)
     payload = {"corpus_mb": args.corpus_mb, "num_blocks": args.num_blocks,
                "host_cpu_count": os.cpu_count(),
-               "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+               "host_usable_cpus": usable_cpu_count(),
+               "host_can_show_scaling": usable_cpu_count() >= 2,
                "phases": {}}
     try:
         if args.only == "coordination":
@@ -720,7 +722,7 @@ def main():
             "mb_per_s_1proc": round(mbps_1p, 2),
             "mb_per_s_nproc": round(mbps_np, 2),
             "scaling_ratio": round(mbps_np / max(mbps_1p, 1e-9), 2),
-            "host_can_show_scaling": (os.cpu_count() or 1) >= 4,
+            "host_can_show_scaling": usable_cpu_count() >= 2,
         }
         # Fleet-telemetry acceptance, from the spool artifacts alone:
         # pipeline_status --json must see the SIGKILLed host as the one

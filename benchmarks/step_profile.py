@@ -34,6 +34,8 @@ sys.path.insert(0, ROOT)
 
 import numpy as np
 
+from lddl_tpu.utils.cpus import usable_cpu_count  # noqa: E402
+
 _COMPONENTS = (
     ("optimizer", re.compile(r"transpose\(jvp\(|/adam|clip_by_global_norm|"
                              r"apply_updates|where|add_any")),
@@ -164,7 +166,7 @@ def packed_compare(args):
                                           duplicate_factor=1),
                 num_blocks=8, sample_ratio=1.0, seed=12345,
                 pack_seq_length=pack, pack_max_per_row=per_row,
-                num_workers=os.cpu_count())
+                num_workers=usable_cpu_count())
             bal = os.path.join(tmp, "bal_" + name)
             balance_shards(pre, bal, 8)
             dirs[name] = bal
@@ -265,7 +267,7 @@ def attribution_profile(args):
             config=BertPretrainConfig(max_seq_length=128,
                                       duplicate_factor=1),
             num_blocks=8, sample_ratio=1.0, seed=12345,
-            num_workers=os.cpu_count())
+            num_workers=usable_cpu_count())
         bal = os.path.join(tmp, "bal")
         balance_shards(pre, bal, 8)
         mdir = os.path.join(tmp, "metrics")

@@ -1,0 +1,133 @@
+"""CI smoke for the in-kernel thread pool: 1-thread vs N-thread shard
+byte identity on one small corpus — GATING — plus an informational
+per-thread-count standalone tokenize MB/s line.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_BENCH=1``. The
+full preprocess pipeline (fused-masked headline config) runs twice,
+``LDDL_TPU_NATIVE_THREADS=1`` vs ``=N`` (N = min(4, usable cores) forced
+to at least 2 so the partitioned code path actually executes even on a
+1-core host), and every output byte — shards AND manifests — must match:
+the Philox replay is per-sample-keyed and the pair streams per-document-
+keyed, so partitioning can never change bytes. Prints one JSON line::
+
+    {"identical": true, "n_threads": ...,
+     "tokenize_mb_per_s_by_threads": {"1": ..., "2": ...}}
+
+The MB/s rows are weather on a busy 1-core CI box — the committed
+PROFILE_PREPROCESS.json is the measurement of record; byte identity is
+the alarm this smoke exists for.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+from lddl_tpu.utils.cpus import usable_cpu_count  # noqa: E402
+
+
+def _tree_digest(out_dir):
+    h = hashlib.sha256()
+    for root, dirs, files in sorted(os.walk(out_dir)):
+        dirs.sort()
+        for name in sorted(files):
+            h.update(name.encode())
+            with open(os.path.join(root, name), "rb") as f:
+                h.update(f.read())
+    return h.hexdigest()
+
+
+def main():
+    target_mb = float(os.environ.get("LDDL_TPU_THREAD_SMOKE_MB", "2"))
+    tmp = tempfile.mkdtemp(prefix="lddl_thread_smoke_")
+    try:
+        from lddl_tpu import native
+        from lddl_tpu.preprocess import (
+            BertPretrainConfig, build_wordpiece_vocab, get_tokenizer,
+            run_bert_preprocess)
+
+        if not native.available():
+            print(json.dumps({"smoke": "native-thread identity pair",
+                              "skipped": "native engine unavailable"}))
+            return 0
+
+        corpus = os.path.join(tmp, "corpus")
+        nbytes, _ = bench.make_corpus(corpus, target_mb, seed=0)
+        sample = []
+        sample_bytes = 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 500_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=8000)
+        tokenizer = get_tokenizer(vocab_file=vocab)
+
+        def run(name, threads):
+            os.environ["LDDL_TPU_NATIVE_THREADS"] = str(threads)
+            try:
+                out = os.path.join(tmp, name)
+                run_bert_preprocess(
+                    {"wikipedia": corpus}, out, tokenizer,
+                    config=BertPretrainConfig(max_seq_length=128,
+                                              duplicate_factor=1,
+                                              masking=True),
+                    num_blocks=8, sample_ratio=1.0, seed=12345,
+                    bin_size=32, num_workers=1)
+            finally:
+                del os.environ["LDDL_TPU_NATIVE_THREADS"]
+            return _tree_digest(out)
+
+        # Force >= 2 threads so the partitioned code path runs even where
+        # only one core is usable (correctness is core-count-independent).
+        n_threads = max(2, min(4, usable_cpu_count()))
+        run("warm", 1)  # native build + tokenizer tables outside the pair
+        d1 = run("t1", 1)
+        dn = run("tn", n_threads)
+        identical = d1 == dn
+
+        # Informational per-thread-count tokenize MB/s (fresh tokenizer
+        # per row so every count pays the same memo warm-up).
+        from lddl_tpu.preprocess.bert import TokenizerInfo
+        rows = {}
+        data = [t.encode("utf-8") for t in sample]
+        sbytes = float(sum(len(d) for d in data))
+        for nt in sorted({1, 2, n_threads}):
+            cls, args = TokenizerInfo(tokenizer).native_tokenizer().\
+                __reduce__()
+            nat = cls(*args)
+            nat.set_threads(nt)
+            nat.tokenize_docs(data[:8])
+            t0 = time.perf_counter()
+            reps = 0
+            elapsed = 0.0
+            while elapsed < 0.5:
+                nat.tokenize_docs(data)
+                reps += 1
+                elapsed = time.perf_counter() - t0
+            rows[str(nt)] = round(sbytes * reps / elapsed / 1e6, 2)
+
+        print(json.dumps({
+            "smoke": "native-thread identity pair",
+            "corpus_mb": round(nbytes / 1024 / 1024, 2),
+            "n_threads": n_threads,
+            "identical": identical,
+            "usable_cpus": usable_cpu_count(),
+            "tokenize_mb_per_s_by_threads": rows,
+        }))
+        return 0 if identical else 1
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

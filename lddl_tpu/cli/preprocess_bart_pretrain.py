@@ -6,6 +6,7 @@ Reference parity: the ``preprocess_bart_pretrain`` console script
 
 from ..preprocess import BartPretrainConfig, run_bart_preprocess
 from ..utils.args import attach_bool_arg
+from ..utils.cpus import usable_cpu_count
 from .common import (apply_storage_backend, arm_fleet_if_requested,
                      attach_corpus_args, attach_elastic_args,
                      attach_fleet_arg, attach_multihost_arg,
@@ -75,7 +76,7 @@ def main(args=None):
             short_seq_prob=args.short_seq_prob,
             splitter=args.splitter,
         ),
-        num_workers=args.local_workers or os.cpu_count() or 1,
+        num_workers=args.local_workers or usable_cpu_count(),
         num_blocks=args.num_blocks,
         sample_ratio=args.sample_ratio,
         seed=args.seed,

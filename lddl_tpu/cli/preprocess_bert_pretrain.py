@@ -111,13 +111,13 @@ def main(args=None):
         splitter=args.splitter,
         schema_version=args.schema_version,
     )
-    import os
+    from ..utils.cpus import usable_cpu_count
     run_bert_preprocess(
         corpus_paths_of(args),
         args.sink,
         tokenizer,
         config=config,
-        num_workers=args.local_workers or os.cpu_count() or 1,
+        num_workers=args.local_workers or usable_cpu_count(),
         num_blocks=args.num_blocks,
         sample_ratio=args.sample_ratio,
         seed=args.seed,

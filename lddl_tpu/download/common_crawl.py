@@ -25,6 +25,7 @@ import threading
 import time
 
 from ..utils.args import attach_bool_arg
+from ..utils.cpus import usable_cpu_count
 from ..utils.fs import expand_outdir_and_mkdir, get_all_files_paths_under
 from .utils import shard_files_parallel
 
@@ -168,7 +169,7 @@ def attach_args(parser=None):
                         help="keep only articles in these languages")
     parser.add_argument("--articles-per-write", type=int, default=1000)
     parser.add_argument("--number-of-extraction-processes", type=int,
-                        default=os.cpu_count(),
+                        default=usable_cpu_count(),
                         help="newsplease extraction process count")
     parser.add_argument("--number-of-sharding-processes", type=int,
                         default=0,

@@ -10,6 +10,8 @@ import os
 import sys
 import urllib.request
 
+from ..utils.cpus import usable_cpu_count
+
 
 def safe_extractall(tf, outdir):
     """tarfile.extractall with the 'data' safety filter where available
@@ -167,7 +169,7 @@ def shard_files_parallel(input_paths, outdir, num_shards, parse_fn,
         for k in range(num_shards)
     ]
     if num_processes is None or num_processes == 0:
-        num_processes = os.cpu_count() or 1
+        num_processes = usable_cpu_count()
     num_processes = min(num_processes, num_shards)
     if num_processes <= 1:
         return sum(_write_shard_from_files(p, fps, parse_fn)

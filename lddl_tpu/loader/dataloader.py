@@ -206,7 +206,8 @@ class DataLoader:
         import os
         if os.environ.get("LDDL_TPU_FORCE_PROCESS_WORKERS"):
             return "process"  # tests / benchmarks of the mode itself
-        ncpu = os.cpu_count() or 1
+        from ..utils.cpus import usable_cpu_count
+        ncpu = usable_cpu_count()
         if ncpu < 2:
             logger = getattr(dataset, "logger", None)
             msg = ("worker_mode='process' on a {}-CPU host: falling back "

@@ -115,7 +115,7 @@ def _load():
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.
         try:
-            if lib.lddl_native_abi_version() != 7:
+            if lib.lddl_native_abi_version() != 8:
                 return None
         except AttributeError:
             return None
@@ -125,6 +125,15 @@ def _load():
         lib.lddl_tok_free.argtypes = [ctypes.c_void_p]
         lib.lddl_tok_set_memo_cap.argtypes = [ctypes.c_void_p,
                                               ctypes.c_int64]
+        lib.lddl_tok_set_threads.restype = None
+        lib.lddl_tok_set_threads.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int32]
+        lib.lddl_tok_get_threads.restype = ctypes.c_int32
+        lib.lddl_tok_get_threads.argtypes = [ctypes.c_void_p]
+        lib.lddl_tok_thread_busy_ns.restype = ctypes.c_int32
+        lib.lddl_tok_thread_busy_ns.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int32]
         lib.lddl_tok_set_splitter.restype = None
         lib.lddl_tok_set_splitter.argtypes = [ctypes.c_void_p,
                                               ctypes.c_char_p,
@@ -154,7 +163,8 @@ def _load():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
             ctypes.c_int32, ctypes.c_double, ctypes.c_int32,
-            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32]
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int32]
         lib.lddl_pairs_free.argtypes = [ctypes.POINTER(_PairResult)]
         lib.lddl_pairs_release.argtypes = [ctypes.POINTER(_PairResult)]
         lib.lddl_tok_result_release.argtypes = [ctypes.POINTER(_TokResult)]
@@ -174,12 +184,13 @@ def _load():
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
             ctypes.c_int32, ctypes.c_int64,
-            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8)]
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int32]
         lib.lddl_split_docs_spans.restype = ctypes.POINTER(_SplitResult)
         lib.lddl_split_docs_spans.argtypes = [
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-            ctypes.c_char_p, ctypes.c_int64]
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
         lib.lddl_bert_instances_masked.restype = \
             ctypes.POINTER(_MaskedInstResult)
         lib.lddl_bert_instances_masked.argtypes = [
@@ -221,6 +232,50 @@ def fused_mask_enabled():
     return (fused_enabled()
             and os.environ.get("LDDL_TPU_NATIVE_FUSED_MASK", "1") != "0"
             and os.environ.get("LDDL_TPU_NATIVE_MASK") != "0")
+
+
+_MAX_THREADS = 64  # kMaxThreads in lddl_native.cpp
+
+
+def resolve_threads(requested=None):
+    """Effective in-kernel thread count (the v8 thread pool).
+
+    Precedence: explicit ``requested`` > ``LDDL_TPU_NATIVE_THREADS`` env
+    (``0`` or ``auto`` -> the process's usable-CPU count; unset/empty/
+    unparsable -> 1). Clamped to [1, 64] (the kernel's kMaxThreads). Read
+    per call so spawned pool workers — which inherit the environment the
+    runner sized for them — resolve their own budget."""
+    if requested is None:
+        raw = os.environ.get("LDDL_TPU_NATIVE_THREADS", "").strip().lower()
+        if raw in ("0", "auto"):
+            from ..utils.cpus import usable_cpu_count
+            requested = usable_cpu_count()
+        else:
+            try:
+                requested = int(raw) if raw else 1
+            except ValueError:
+                requested = 1
+    return max(1, min(_MAX_THREADS, int(requested)))
+
+
+def thread_plan(requested, n_items):
+    """Refusal ladder for a partition request: -> (effective, reason).
+
+    The kernel never splits finer than one item per thread, so a bucket
+    with fewer documents than the configured pool silently runs narrower;
+    this mirrors that clamp on the Python side so callers (and the
+    observability gauge) report the thread count that actually ran.
+    ``reason`` is None when the request was honored, else a short tag
+    (``"n_items"``, ``"cap"``, ``"floor"``) naming the clamp that fired."""
+    requested = int(requested)
+    eff = max(1, min(_MAX_THREADS, requested, max(1, int(n_items))))
+    if eff == requested:
+        return eff, None
+    if requested < 1:
+        return eff, "floor"
+    if requested > _MAX_THREADS and eff == _MAX_THREADS:
+        return eff, "cap"
+    return eff, "n_items"
 
 
 def _owned_array(lib, ptr, n, ctype, dtype):
@@ -322,6 +377,27 @@ class NativeTokenizer:
         if splitter_blob:
             lib.lddl_tok_set_splitter(self._handle, splitter_blob,
                                       len(splitter_blob))
+        # Thread budget is resolved from the environment, NOT pickled in
+        # _args: a pool worker rebuilding the tokenizer sizes itself from
+        # the env the runner set for it, not from the parent's budget.
+        lib.lddl_tok_set_threads(self._handle, resolve_threads())
+
+    def set_threads(self, n):
+        """Resize the in-kernel thread pool (clamped to [1, 64])."""
+        self._lib.lddl_tok_set_threads(self._handle, int(n))
+
+    def get_threads(self):
+        """Configured pool width (a bucket with fewer docs runs narrower)."""
+        return int(self._lib.lddl_tok_get_threads(self._handle))
+
+    def thread_busy_ns(self):
+        """Cumulative per-thread busy nanoseconds since construction, one
+        entry per configured thread slot. Callers diff successive reads to
+        attribute wall time (native_thread_busy_seconds_total{tid})."""
+        out = (ctypes.c_int64 * _MAX_THREADS)()
+        n = self._lib.lddl_tok_thread_busy_ns(self._handle, out,
+                                              _MAX_THREADS)
+        return [int(out[i]) for i in range(max(0, n))]
 
     def set_splitter(self, blob):
         """Attach (or clear, blob=None) corpus-learned punkt splitter
@@ -500,11 +576,13 @@ class NativeTokenizer:
 
 def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
                short_seq_prob, duplicate_factor, seed, bucket, cls_id,
-               sep_id):
+               sep_id, threads=None):
     """NSP pair creation over a tokenized bucket (lddl_tok_docs output),
     replaying the frozen CounterRNG streams of the Python engine
     (preprocess.bert.pairs_from_documents). Returns flat instance arrays
-    (seq_ids, seq_lens, a_lens, is_random_next)."""
+    (seq_ids, seq_lens, a_lens, is_random_next). ``threads=None`` resolves
+    the pool width from LDDL_TPU_NATIVE_THREADS; output is byte-identical
+    at every width (the pair streams are per-document-keyed)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native engine unavailable")
@@ -517,7 +595,8 @@ def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
         len(sent_lens), doc_sent_counts.ctypes.data_as(p_i32),
         len(doc_sent_counts), int(max_seq_length), float(short_seq_prob),
         int(duplicate_factor), int(seed) & (2**64 - 1),
-        int(bucket) & (2**64 - 1), int(cls_id), int(sep_id))
+        int(bucket) & (2**64 - 1), int(cls_id), int(sep_id),
+        resolve_threads(threads))
     try:
         r = res.contents
         n = r.n_instances
@@ -538,7 +617,7 @@ def bert_pairs(ids, sent_lens, doc_sent_counts, max_seq_length,
 
 
 def mask_batch(key_bytes, ids, candidate, num_to_predict, mask_id,
-               vocab_size):
+               vocab_size, threads=None):
     """Static MLM masking — a bit-exact native replay of
     ops.masking.mask_batch_numpy on the numpy-Philox stream keyed by
     ``key_bytes`` (utils.rng.sample_key_bytes). Returns (masked_ids,
@@ -565,11 +644,12 @@ def mask_batch(key_bytes, ids, candidate, num_to_predict, mask_id,
         ids.ctypes.data_as(p_i32), candidate.ctypes.data_as(p_u8),
         num_to_predict.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n, width, int(mask_id), vocab_size,
-        out.ctypes.data_as(p_i32), selected.ctypes.data_as(p_u8))
+        out.ctypes.data_as(p_i32), selected.ctypes.data_as(p_u8),
+        resolve_threads(threads))
     return out, selected.view(np.bool_)
 
 
-def split_docs(texts, splitter_blob=None):
+def split_docs(texts, splitter_blob=None, threads=None):
     """Sentence-split documents natively -> list of sentence lists.
 
     Same boundaries as preprocess.sentences.split_sentences — or, with
@@ -588,7 +668,8 @@ def split_docs(texts, splitter_blob=None):
     p_i64 = ctypes.POINTER(ctypes.c_int64)
     res = lib.lddl_split_docs_spans(
         buf, starts.ctypes.data_as(p_i64), ends.ctypes.data_as(p_i64),
-        n, splitter_blob, len(splitter_blob or b""))
+        n, splitter_blob, len(splitter_blob or b""),
+        resolve_threads(threads))
     try:
         r = res.contents
         starts_o = np.ctypeslib.as_array(r.starts, shape=(r.n_sents,)).copy()

@@ -159,8 +159,10 @@ def ensure_built(verbose=False):
                     # LDDL_TPU_NATIVE_MARCH (e.g. x86-64-v2); a host whose
                     # arch tag mismatches the cached .so rebuilds instead
                     # of SIGILL-ing (_lib_meta_tag in the staleness check).
+                    # -pthread: the v8 engine runs an in-kernel thread
+                    # pool (LDDL_TPU_NATIVE_THREADS).
                     cmd = ["g++", "-O3", "-march=" + _march(), "-std=c++17",
-                           "-shared", "-fPIC",
+                           "-shared", "-fPIC", "-pthread",
                            SRC, "-o", tmp]
                     proc = subprocess.run(cmd, capture_output=True, text=True)
                     if proc.returncode != 0:

@@ -147,7 +147,10 @@ class BartBucketProcessor:
         view; boundaries are identical to the Python splitters (pinned by
         tests/test_native.py + test_fused.py), so chunk bytes cannot
         depend on the engine. ``LDDL_TPU_BART_NATIVE_SPLIT=0`` forces the
-        Python path."""
+        Python path. The split kernel partitions documents across the
+        LDDL_TPU_NATIVE_THREADS pool (the runner sizes that env so
+        workers x threads never oversubscribes the host); output is
+        byte-identical at any width (tests/test_native_threads.py)."""
         import os
         if os.environ.get("LDDL_TPU_BART_NATIVE_SPLIT") == "0":
             return None

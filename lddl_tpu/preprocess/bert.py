@@ -30,6 +30,7 @@ import json
 
 import numpy as np
 
+from .. import observability as obs
 from ..ops.masking import (
     make_jax_masker,
     make_jax_whole_word_masker,
@@ -329,6 +330,30 @@ def documents_from_texts(texts, tokenizer, engine="auto",
     return documents
 
 
+def _emit_native_thread_metrics(nat):
+    """Pool-attribution metrics after a native kernel call: the configured
+    width (``native_threads`` gauge) plus per-thread busy-time deltas
+    (``native_thread_busy_seconds_total{tid}``). Together they tell a
+    starved pool (every tid busy but wall flat -> oversubscribed host)
+    from a serial floor (tid 0 busy, the rest idle -> the bucket was too
+    small to partition). Counters are cumulative on the kernel side; the
+    previous reading is cached on the tokenizer and diffed here."""
+    if not obs.enabled():
+        return
+    try:
+        obs.set_gauge("native_threads", nat.get_threads())
+        busy = nat.thread_busy_ns()
+        prev = getattr(nat, "_busy_prev", [])
+        for t, b in enumerate(busy):
+            d = b - (prev[t] if t < len(prev) else 0)
+            if d > 0:
+                obs.inc("native_thread_busy_seconds_total", d / 1e9,
+                        tid=str(t))
+        nat._busy_prev = busy
+    except Exception:  # lddl: disable=swallowed-error (metrics-only path)
+        pass
+
+
 def instances_from_texts(texts, tok_info, config, seed, bucket,
                          splitter_params=None):
     """Texts -> InstanceBatch via the configured engine (the whole bucket
@@ -363,6 +388,7 @@ def instances_from_texts(texts, tok_info, config, seed, bucket,
                     texts, config.max_seq_length, config.short_seq_prob,
                     config.duplicate_factor, seed, bucket, tok_info.cls_id,
                     tok_info.sep_id, want_ab=not config.masking)
+            _emit_native_thread_metrics(nat)
             return InstanceBatch(seq_ids, seq_lens, a_lens, rn,
                                  a_ids=a_ids, b_ids=b_ids)
         # STAGED rung (LDDL_TPU_NATIVE_FUSED=0): two native calls with
@@ -372,6 +398,7 @@ def instances_from_texts(texts, tok_info, config, seed, bucket,
             ids, sent_lens, doc_counts, config.max_seq_length,
             config.short_seq_prob, config.duplicate_factor, seed, bucket,
             tok_info.cls_id, tok_info.sep_id)
+        _emit_native_thread_metrics(nat)
         return InstanceBatch(seq_ids, seq_lens, a_lens, rn)
     documents = documents_from_texts(texts, tok_info, engine="hf",
                                      splitter_params=splitter_params)
@@ -580,6 +607,7 @@ def masked_instances_from_texts(texts, tok_info, config, seed, bucket,
         min(128, config.max_seq_length))
     if res is None:
         return None
+    _emit_native_thread_metrics(nat)
     return MaskedInstanceBatch(*res)
 
 

@@ -12,6 +12,7 @@ from .fs import (
     NUM_SAMPLES_CACHE_NAME,
 )
 from .args import attach_bool_arg, parse_str_of_num_bytes
+from .cpus import usable_cpu_count
 from . import rng
 
 __all__ = [
@@ -28,5 +29,6 @@ __all__ = [
     "NUM_SAMPLES_CACHE_NAME",
     "attach_bool_arg",
     "parse_str_of_num_bytes",
+    "usable_cpu_count",
     "rng",
 ]

@@ -1,0 +1,20 @@
+"""CPU-count detection that respects cgroup/affinity limits.
+
+``os.cpu_count()`` reports the machine's cores, not this process's
+allowance — inside a cgroup-limited container or after sched_setaffinity
+it overcounts, so every pool/probe-plan/thread-pool sized from it
+oversubscribes the host. ``usable_cpu_count()`` is the one sizing
+primitive the whole tree uses instead (ISSUE 18 satellite bugfix).
+"""
+
+import os
+
+
+def usable_cpu_count():
+    """Number of CPUs THIS process may run on: the scheduling-affinity
+    set where the platform exposes it (Linux), else ``os.cpu_count()``.
+    Never returns less than 1."""
+    try:
+        return max(1, len(os.sched_getaffinity(0)))
+    except (AttributeError, OSError):  # non-Linux / restricted proc
+        return max(1, os.cpu_count() or 1)

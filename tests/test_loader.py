@@ -239,7 +239,11 @@ def test_process_mode_falls_back_on_single_core(pipeline, monkeypatch):
     from lddl_tpu.loader.dataloader import DataLoader
 
     monkeypatch.delenv("LDDL_TPU_FORCE_PROCESS_WORKERS", raising=False)
+    # The mode check sizes itself from the affinity-aware count
+    # (utils.cpus.usable_cpu_count), so patch both probes.
     monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    monkeypatch.setattr(os, "sched_getaffinity", lambda pid: {0},
+                        raising=False)
     lt = _loader(pipeline, "dyn", num_workers=2)
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
@@ -254,6 +258,8 @@ def test_process_mode_falls_back_on_single_core(pipeline, monkeypatch):
 
     # >= 2 cores: process mode sticks.
     monkeypatch.setattr(os, "cpu_count", lambda: 8)
+    monkeypatch.setattr(os, "sched_getaffinity",
+                        lambda pid: set(range(8)), raising=False)
     assert DataLoader._check_process_mode(None) == "process"
 
 

@@ -1,0 +1,359 @@
+"""In-kernel thread pool (ABI v8): byte identity across thread counts.
+
+PR 18 partitions the native engine's document/bucket ranges across
+``LDDL_TPU_NATIVE_THREADS`` worker threads into per-thread output arenas
+stitched back into the flat-segment ABI. Because the Philox replay is
+per-sample-keyed and the pair streams per-document-keyed, partitioning
+must be byte-invisible: 1-thread and N-thread runs emit identical arrays
+in process and identical shards + manifests end to end. These tests pin
+that, the thread refusal ladder (env parsing, kMaxThreads cap, n_items
+clamp), torn-partition edges (empty slice, single giant document, more
+threads than documents), and the busy-time telemetry counters.
+"""
+
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+from lddl_tpu import native
+from lddl_tpu.preprocess import build_wordpiece_vocab, get_tokenizer
+from lddl_tpu.preprocess.bert import TokenizerInfo
+from lddl_tpu.utils import rng as lrng
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native engine did not build")
+
+from test_native import DOCS  # noqa: E402  (shared corpus fixture)
+
+from lddl_tpu.utils.cpus import usable_cpu_count  # noqa: E402
+
+THREAD_COUNTS = sorted({1, 2, 4, usable_cpu_count()})
+
+
+@pytest.fixture(scope="module")
+def vocab_file(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tvocab") / "vocab.txt"
+    return build_wordpiece_vocab(DOCS * 3, str(path), vocab_size=400)
+
+
+@pytest.fixture(scope="module")
+def hf_tokenizer(vocab_file):
+    return get_tokenizer(vocab_file=vocab_file)
+
+
+@pytest.fixture()
+def corpus_dir(tmp_path):
+    source = tmp_path / "corpus" / "source"
+    source.mkdir(parents=True)
+    with open(source / "0.txt", "w", encoding="utf-8") as f:
+        for i, d in enumerate(DOCS * 4):
+            if d.strip():
+                f.write("doc-{} {}\n".format(i, d.replace("\n", " ")
+                                             .replace("\r", " ")
+                                             .replace("\t", " ")
+                                             .replace("\x00", "")))
+    return str(tmp_path / "corpus")
+
+
+def _tree_hashes(out_dir):
+    """Digest EVERY output file — shards AND dotfile manifests — so a
+    thread count that perturbed row ordering, shard sizing, or manifest
+    contents (not just id payloads) is caught."""
+    digests = {}
+    for root, dirs, files in os.walk(out_dir):
+        dirs.sort()
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            with open(path, "rb") as f:
+                digests[os.path.relpath(path, out_dir)] = hashlib.sha256(
+                    f.read()).hexdigest()
+    return digests
+
+
+# ---------------------------------------------------------------------------
+# In-process kernel byte identity at every entry point
+# ---------------------------------------------------------------------------
+
+
+def _assert_same_arrays(ref, got, label):
+    assert len(ref) == len(got)
+    for i, (r, g) in enumerate(zip(ref, got)):
+        if r is None or g is None:
+            assert r is None and g is None
+            continue
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                      err_msg="{}[{}]".format(label, i))
+
+
+def test_tokenize_docs_identity_across_threads(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d for d in DOCS if d.strip()] * 6
+    nat.set_threads(1)
+    ref = nat.tokenize_docs(texts)
+    for nt in THREAD_COUNTS[1:] + [7]:
+        nat.set_threads(nt)
+        _assert_same_arrays(ref, nat.tokenize_docs(texts),
+                            "tokenize@{}t".format(nt))
+
+
+def test_bert_pairs_identity_across_threads(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    nat.set_threads(1)
+    texts = [d for d in DOCS if d.strip()] * 4
+    ids, sl, dc = nat.tokenize_docs(texts)
+    ref = native.bert_pairs(ids, sl, dc, 48, 0.1, 3, 12345, 7,
+                            info.cls_id, info.sep_id, threads=1)
+    for nt in THREAD_COUNTS[1:] + [7]:
+        got = native.bert_pairs(ids, sl, dc, 48, 0.1, 3, 12345, 7,
+                                info.cls_id, info.sep_id, threads=nt)
+        _assert_same_arrays(ref, got, "pairs@{}t".format(nt))
+
+
+def test_fused_instances_identity_across_threads(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d for d in DOCS if d.strip()] * 4
+    nat.set_threads(1)
+    ref = nat.bert_instances(texts, 48, 0.1, 3, 9, 1, info.cls_id,
+                             info.sep_id, want_ab=True)
+    for nt in THREAD_COUNTS[1:] + [7]:
+        nat.set_threads(nt)
+        got = nat.bert_instances(texts, 48, 0.1, 3, 9, 1, info.cls_id,
+                                 info.sep_id, want_ab=True)
+        _assert_same_arrays(ref, got, "fused@{}t".format(nt))
+
+
+def test_fused_masked_identity_across_threads(hf_tokenizer):
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d for d in DOCS if d.strip()] * 4
+    key = lrng.sample_key_bytes(7, 0x3A5C, 3)
+
+    def run():
+        return nat.bert_instances_masked(
+            texts, 48, 0.1, 2, 7, 3, info.cls_id, info.sep_id, key,
+            info.mask_id, info.vocab_size, 0.15, 8, 48)
+
+    nat.set_threads(1)
+    ref = run()
+    assert ref is not None
+    for nt in THREAD_COUNTS[1:] + [7]:
+        nat.set_threads(nt)
+        _assert_same_arrays(ref, run(), "masked@{}t".format(nt))
+
+
+def test_split_docs_identity_across_threads(hf_tokenizer):
+    texts = [d for d in DOCS if d.strip()] * 5
+    ref = native.split_docs(texts, threads=1)
+    for nt in THREAD_COUNTS[1:] + [7]:
+        _assert_same_arrays(ref, native.split_docs(texts, threads=nt),
+                            "split@{}t".format(nt))
+
+
+def test_mask_batch_identity_across_threads():
+    g = np.random.default_rng(3)
+    ids = g.integers(0, 30522, (40, 128)).astype(np.int32)
+    cand = g.random((40, 128)) < 0.6
+    ntp = g.integers(0, 20, 40).astype(np.int64)
+    key = lrng.sample_key_bytes(7, 0x3A5C, 0)
+    ref = native.mask_batch(key, ids, cand, ntp, 4, 30522, threads=1)
+    assert ref is not None
+    for nt in THREAD_COUNTS[1:] + [7]:
+        got = native.mask_batch(key, ids, cand, ntp, 4, 30522, threads=nt)
+        _assert_same_arrays(ref, got, "mask@{}t".format(nt))
+
+
+# ---------------------------------------------------------------------------
+# Torn-partition edges
+# ---------------------------------------------------------------------------
+
+
+def test_empty_input_at_width(hf_tokenizer):
+    """Zero documents with a wide pool: every thread gets an empty slice;
+    no crash, empty outputs."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    nat.set_threads(8)
+    ids, sl, dc = nat.tokenize_docs([])
+    assert len(ids) == 0 and len(sl) == 0 and len(dc) == 0
+    got = nat.bert_instances([], 48, 0.1, 2, 7, 0, info.cls_id,
+                             info.sep_id)
+    assert all(len(a) == 0 for a in got[:4])
+    assert native.split_docs([], threads=8) is not None
+
+
+def test_single_giant_document_many_threads(hf_tokenizer):
+    """One document, eight threads: the partitioner must hand the whole
+    range to one worker (clamp to n_items) and still match 1-thread
+    bytes."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    giant = [" ".join(d for d in DOCS if d.strip()) * 40]
+    nat.set_threads(1)
+    ref_tok = nat.tokenize_docs(giant)
+    ref_inst = nat.bert_instances(giant, 48, 0.1, 2, 5, 2, info.cls_id,
+                                  info.sep_id, want_ab=True)
+    nat.set_threads(8)
+    _assert_same_arrays(ref_tok, nat.tokenize_docs(giant), "giant-tok")
+    _assert_same_arrays(ref_inst,
+                        nat.bert_instances(giant, 48, 0.1, 2, 5, 2,
+                                           info.cls_id, info.sep_id,
+                                           want_ab=True), "giant-inst")
+
+
+def test_fewer_documents_than_threads(hf_tokenizer):
+    """n_docs < configured width: trailing threads get empty slices."""
+    info = TokenizerInfo(hf_tokenizer)
+    nat = info.native_tokenizer()
+    texts = [d for d in DOCS if d.strip()][:3]
+    nat.set_threads(1)
+    ref = nat.tokenize_docs(texts)
+    nat.set_threads(16)
+    _assert_same_arrays(ref, nat.tokenize_docs(texts), "short-slice")
+
+
+# ---------------------------------------------------------------------------
+# Refusal ladder: env parsing, clamps, plan reasons
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_threads_env_parsing(monkeypatch):
+    monkeypatch.delenv("LDDL_TPU_NATIVE_THREADS", raising=False)
+    assert native.resolve_threads() == 1          # unset -> serial
+    monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", "")
+    assert native.resolve_threads() == 1          # empty -> serial
+    monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", "garbage")
+    assert native.resolve_threads() == 1          # unparsable -> serial
+    monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", "4")
+    assert native.resolve_threads() == 4
+    assert native.resolve_threads(2) == 2         # explicit beats env
+    for auto in ("0", "auto", "AUTO"):
+        monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", auto)
+        assert native.resolve_threads() == usable_cpu_count()
+    monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", "9999")
+    assert native.resolve_threads() == 64         # kMaxThreads cap
+    assert native.resolve_threads(-3) == 1        # floor
+
+
+def test_thread_plan_reasons():
+    assert native.thread_plan(4, 100) == (4, None)
+    assert native.thread_plan(4, 2) == (2, "n_items")
+    assert native.thread_plan(8, 1) == (1, "n_items")
+    assert native.thread_plan(99, 1000) == (64, "cap")
+    assert native.thread_plan(0, 10) == (1, "floor")
+    assert native.thread_plan(-2, 10) == (1, "floor")
+    assert native.thread_plan(1, 0) == (1, None)
+
+
+def test_set_threads_clamps_in_kernel(hf_tokenizer):
+    nat = TokenizerInfo(hf_tokenizer).native_tokenizer()
+    nat.set_threads(4)
+    assert nat.get_threads() == 4
+    nat.set_threads(0)
+    assert nat.get_threads() == 1
+    nat.set_threads(9999)
+    assert nat.get_threads() == 64
+
+
+def test_tokenizer_width_follows_env(hf_tokenizer, monkeypatch):
+    """A freshly constructed tokenizer (the pool-worker path: __reduce__
+    args + inherited env) picks up LDDL_TPU_NATIVE_THREADS."""
+    monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", "3")
+    cls, args = TokenizerInfo(hf_tokenizer).native_tokenizer().__reduce__()
+    assert cls(*args).get_threads() == 3
+
+
+# ---------------------------------------------------------------------------
+# Busy-time telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_thread_busy_ns_accumulates(hf_tokenizer):
+    nat = TokenizerInfo(hf_tokenizer).native_tokenizer()
+    texts = [d for d in DOCS if d.strip()] * 6
+    nat.set_threads(2)
+    before = nat.thread_busy_ns()
+    assert len(before) == 2                # one slot per configured thread
+    assert all(v >= 0 for v in before)
+    nat.tokenize_docs(texts)
+    after = nat.thread_busy_ns()
+    assert after[0] > before[0]            # caller thread always works
+    assert all(a >= b for a, b in zip(after, before))  # cumulative
+    nat.set_threads(4)
+    assert len(nat.thread_busy_ns()) == 4  # follows the width
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: shards + manifests identical across thread counts
+# ---------------------------------------------------------------------------
+
+
+def _run_pipeline(corpus_dir, out, tokenizer, monkeypatch, threads,
+                  env=None, **kwargs):
+    from lddl_tpu.preprocess import BertPretrainConfig, run_bert_preprocess
+    cfg = dict(max_seq_length=48, duplicate_factor=2, masking=True,
+               tokenizer_engine="native")
+    cfg.update({k: kwargs.pop(k) for k in list(kwargs)
+                if k in ("masking", "schema_version")})
+    env = dict(env or {})
+    env["LDDL_TPU_NATIVE_THREADS"] = str(threads)
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    try:
+        run_bert_preprocess(
+            {"wikipedia": corpus_dir}, out, tokenizer,
+            config=BertPretrainConfig(**cfg),
+            num_blocks=3, sample_ratio=1.0, seed=7, **kwargs)
+    finally:
+        for key in env:
+            monkeypatch.delenv(key, raising=False)
+    return _tree_hashes(out)
+
+
+@pytest.mark.parametrize("name,env,kwargs", [
+    ("fused_masked_binned", {}, {"bin_size": 16}),
+    ("staged", {"LDDL_TPU_NATIVE_FUSED": "0"}, {"bin_size": 16}),
+    ("unmasked", {}, {"masking": False}),
+    ("packed", {}, {"masking": False, "schema_version": 2,
+                    "pack_seq_length": 64}),
+])
+def test_pipeline_identity_across_threads(hf_tokenizer, corpus_dir,
+                                          tmp_path, monkeypatch, name, env,
+                                          kwargs):
+    """The headline configs (fused-masked-binned, staged, unmasked,
+    offline-packed) emit byte-identical trees — shards AND manifests — at
+    1 vs 4 kernel threads."""
+    one = _run_pipeline(corpus_dir, str(tmp_path / "t1"), hf_tokenizer,
+                        monkeypatch, 1, env=env, **dict(kwargs))
+    four = _run_pipeline(corpus_dir, str(tmp_path / "t4"), hf_tokenizer,
+                         monkeypatch, 4, env=env, **dict(kwargs))
+    assert one == four
+    assert any("parquet" in k for k in one)
+    assert any(".manifest" in k for k in one)  # manifests ARE compared
+
+
+def test_bart_pipeline_identity_across_threads(corpus_dir, tmp_path,
+                                               monkeypatch):
+    """BART's whole-bucket native split partitions across threads too;
+    the emitted trees must not notice."""
+    from lddl_tpu.preprocess import BartPretrainConfig, run_bart_preprocess
+
+    def run(out, threads):
+        monkeypatch.setenv("LDDL_TPU_NATIVE_THREADS", str(threads))
+        try:
+            run_bart_preprocess(
+                {"wikipedia": corpus_dir}, out,
+                config=BartPretrainConfig(target_seq_length=48),
+                num_blocks=3, sample_ratio=1.0, seed=11)
+        finally:
+            monkeypatch.delenv("LDDL_TPU_NATIVE_THREADS", raising=False)
+        return _tree_hashes(out)
+
+    one = run(str(tmp_path / "t1"), 1)
+    four = run(str(tmp_path / "t4"), 4)
+    assert one == four
+    assert one

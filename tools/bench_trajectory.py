@@ -112,6 +112,28 @@ def load_sink_overlap(repo_root):
     return out
 
 
+def load_thread_scaling(repo_root):
+    """The per-thread-count tokenize MB/s block (and sentence-memo win)
+    from PROFILE_PREPROCESS.json — informational: a 1-core host records
+    the rows without being able to show speedup. None when the artifact
+    predates the v8 threaded kernel."""
+    path = os.path.join(repo_root, "PROFILE_PREPROCESS.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    scaling = doc.get("native_thread_scaling")
+    if not isinstance(scaling, dict):
+        return None
+    out = dict(scaling)
+    out["host_can_show_scaling"] = doc.get("host_can_show_scaling")
+    memo = doc.get("sentence_memo")
+    if isinstance(memo, dict):
+        out["sentence_memo_speedup"] = memo.get("memo_speedup")
+    return out
+
+
 def load_coordination(repo_root):
     """The elastic coordination-cost and autoscale-episode blocks from
     SCALE_RUN.json (lease filesystem ops per unit, legacy vs batched;
@@ -223,6 +245,7 @@ def main(argv=None):
         "loader": load_loader_bench(args.repo_root),
         "sink_overlap": load_sink_overlap(args.repo_root),
         "coordination": load_coordination(args.repo_root),
+        "thread_scaling": load_thread_scaling(args.repo_root),
     }
     if args.series_dir:
         result["live_rates"] = load_live_rates(args.series_dir, args.window)
@@ -286,6 +309,22 @@ def main(argv=None):
                 and overlap.get("previous_mb_per_s") is not None:
             line += "; single-worker {} -> {} MB/s".format(
                 overlap["previous_mb_per_s"], overlap["producer_mb_per_s"])
+        print(line)
+    threads = result["thread_scaling"]
+    if threads and threads.get("tokenize_mb_per_s_by_threads"):
+        rows_t = threads["tokenize_mb_per_s_by_threads"]
+        line = ("native thread scaling (PROFILE_PREPROCESS, "
+                "informational): tokenize " + ", ".join(
+                    "{}t={} MB/s".format(k, rows_t[k])
+                    for k in sorted(rows_t, key=int)))
+        if threads.get("speedup_2_threads") is not None:
+            line += " ({}x at 2 threads)".format(
+                threads["speedup_2_threads"])
+        if threads.get("sentence_memo_speedup") is not None:
+            line += "; sentence-memo win {}x on repeated buckets".format(
+                threads["sentence_memo_speedup"])
+        if not threads.get("host_can_show_scaling"):
+            line += " [host too small to show scaling]"
         print(line)
     coord = result["coordination"]
     if coord:

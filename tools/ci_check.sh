@@ -89,6 +89,17 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
         echo "ci_check: backend smoke FAILED — local/mock divergence or crash" >&2
         exit 1
     fi
+    # Native thread-pool smoke: the same preprocess run at 1 kernel
+    # thread vs N. Byte identity (shards + manifests) is GATING — the
+    # per-sample-keyed RNG contracts make partitioning invisible in the
+    # output, so any divergence is a kernel bug; the per-thread-count
+    # tokenize MB/s rows it prints are informational.
+    if JAX_PLATFORMS=cpu python benchmarks/thread_smoke.py; then
+        echo "ci_check: native 1-vs-N thread identity smoke OK (MB/s non-gating)"
+    else
+        echo "ci_check: thread smoke FAILED — 1-vs-N thread divergence or crash" >&2
+        exit 1
+    fi
     # Diagnosis-surface smoke: a tiny fleet-armed preprocess -> balance
     # -> load run, then pipeline_status driven as an operator would.
     # GATING: `--json --window` must parse with windowed series rates
