@@ -1,0 +1,147 @@
+"""CI smoke for the sanitizer-hardened native engine: rebuild the
+kernel under TSan+UBSan and run the 1-vs-N entry-point identity suite
+(tests/test_native_threads.py) against it — GATING on any sanitizer
+report.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_SANITIZE=1``.
+Four steps, each in a subprocess so the instrumented .so never loads
+into the driver process:
+
+1. build — ``LDDL_TPU_NATIVE_SANITIZE=tsan,ubsan python -m
+   lddl_tpu.native.build``. GATING: a failed build falling back to the
+   HF path would pass the identity suite vacuously.
+2. availability assert — ``native.available()`` must be True under the
+   sanitized env. dlopen'ing a TSan .so requires the TSan runtime in
+   the process, so steps 2-3 run under ``LD_PRELOAD=libtsan.so``
+   (located via ``g++ -print-file-name``). This step exists so a
+   preload/runtime problem fails LOUDLY instead of silently demoting
+   the suite to the fallback engine.
+3. identity suite — pytest tests/test_native_threads.py with
+   ``TSAN_OPTIONS=exitcode=66 halt_on_error=0 log_path=...`` and
+   ``UBSAN_OPTIONS=halt_on_error=1``: TSan collects every report into
+   the log files and forces a nonzero exit; UBSan aborts on first
+   report. benchmarks/tsan_suppressions.txt silences ONLY
+   uninstrumented third-party noise (pyarrow's bundled mimalloc) —
+   the kernel itself stays fully checked.
+4. verdict — fail on nonzero pytest exit OR any report text in the
+   TSan logs.
+
+Skips loudly (exit 0 + JSON line) only when the toolchain cannot do
+the job at all: no g++/libtsan on the host. Prints one JSON line::
+
+    {"smoke": "native sanitize (tsan+ubsan)", "passed": true,
+     "sanitizer_reports": 0, ...}
+"""
+
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+MODES = "tsan,ubsan"
+SUPPRESSIONS = os.path.join(ROOT, "benchmarks", "tsan_suppressions.txt")
+
+
+def _find_libtsan():
+    try:
+        out = subprocess.run(["g++", "-print-file-name=libtsan.so"],
+                             capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    path = out.stdout.strip()
+    # When gcc can't find the file it echoes the bare name back.
+    if out.returncode == 0 and os.path.isabs(path) \
+            and os.path.exists(path):
+        return path
+    return None
+
+
+def main():
+    libtsan = _find_libtsan()
+    if libtsan is None:
+        print(json.dumps({"smoke": "native sanitize (tsan+ubsan)",
+                          "skipped": "g++/libtsan unavailable"}))
+        return 0
+
+    log_dir = tempfile.mkdtemp(prefix="lddl_sanitize_smoke_")
+    try:
+        env = dict(os.environ)
+        env["LDDL_TPU_NATIVE_SANITIZE"] = MODES
+        env["JAX_PLATFORMS"] = "cpu"
+
+        # 1. Build the instrumented kernel (no preload needed: the
+        # compiler links the runtime; only LOADING needs it).
+        build = subprocess.run(
+            [sys.executable, "-m", "lddl_tpu.native.build"],
+            cwd=ROOT, env=env, capture_output=True, text=True)
+        if build.returncode != 0:
+            print(json.dumps({
+                "smoke": "native sanitize (tsan+ubsan)", "passed": False,
+                "failed_step": "build",
+                "stderr_tail": build.stderr[-2000:]}))
+            return 1
+
+        env["LD_PRELOAD"] = libtsan
+        env["TSAN_OPTIONS"] = (
+            "exitcode=66 halt_on_error=0 log_path={} suppressions={}"
+            .format(os.path.join(log_dir, "tsan_report"), SUPPRESSIONS))
+        env["UBSAN_OPTIONS"] = "halt_on_error=1 print_stacktrace=1"
+
+        # 2. The sanitized engine must actually be the one under test.
+        avail = subprocess.run(
+            [sys.executable, "-c",
+             "from lddl_tpu import native; "
+             "raise SystemExit(0 if native.available() else 3)"],
+            cwd=ROOT, env=env, capture_output=True, text=True)
+        if avail.returncode != 0:
+            print(json.dumps({
+                "smoke": "native sanitize (tsan+ubsan)", "passed": False,
+                "failed_step": "availability (sanitized engine did not "
+                               "load; identity suite would be vacuous)",
+                "stderr_tail": avail.stderr[-2000:]}))
+            return 1
+
+        # 3. The 1-vs-N entry-point identity suite under the
+        # instrumented kernel.
+        suite = subprocess.run(
+            [sys.executable, "-m", "pytest",
+             "tests/test_native_threads.py", "-q",
+             "-p", "no:cacheprovider"],
+            cwd=ROOT, env=env, capture_output=True, text=True)
+
+        # 4. Verdict: the suite must pass AND the TSan logs must be
+        # report-free (halt_on_error=0 collects every report instead of
+        # stopping at the first, so one run shows the full set).
+        reports = 0
+        for path in sorted(glob.glob(os.path.join(log_dir,
+                                                  "tsan_report.*"))):
+            with open(path, encoding="utf-8", errors="replace") as f:
+                reports += f.read().count("WARNING: ThreadSanitizer")
+        passed = suite.returncode == 0 and reports == 0
+        result = {
+            "smoke": "native sanitize (tsan+ubsan)",
+            "passed": passed,
+            "suite_exit": suite.returncode,
+            "sanitizer_reports": reports,
+            "libtsan": libtsan,
+        }
+        if not passed:
+            result["stdout_tail"] = suite.stdout[-2000:]
+            tails = [open(p, encoding="utf-8", errors="replace").read()
+                     for p in sorted(glob.glob(
+                         os.path.join(log_dir, "tsan_report.*")))]
+            result["tsan_report_tail"] = "".join(tails)[-4000:]
+        print(json.dumps(result))
+        return 0 if passed else 1
+    finally:
+        shutil.rmtree(log_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

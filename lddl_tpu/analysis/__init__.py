@@ -32,6 +32,7 @@ from .core import (  # noqa: F401
 )
 from . import rules  # noqa: F401  (imports register the syntactic rules)
 from . import flow_rules  # noqa: F401  (registers the flow rules)
+from . import concurrency  # noqa: F401  (registers the concurrency rules)
 from . import dataflow, project  # noqa: F401  (taint engine + model)
 from .sarif import to_sarif  # noqa: F401
 

@@ -451,6 +451,7 @@ def run_check(paths, rules=None, baseline_path=None, root=None,
       the interprocedural fixpoint) still covers all of ``paths``. This
       is the ``--changed-only`` fast path.
     """
+    from . import concurrency as _conc
     from . import flow_rules as _flow
     from . import dataflow as _dataflow
     from . import project as _project
@@ -477,6 +478,7 @@ def run_check(paths, rules=None, baseline_path=None, root=None,
     proj = _project.Project()
     parsed_modules = []  # ModuleInfo needing fact extraction
     module_facts = []  # dataflow._ModuleFacts for every healthy file
+    conc_facts = []  # concurrency._CModuleFacts for every healthy file
     per_file = {}  # relpath -> {"supp": {...}, "lines": [...]}
     findings = []  # pre-baseline, post-suppression
     cache_entries_pending = {}  # relpath -> entry missing "facts"
@@ -516,6 +518,8 @@ def run_check(paths, rules=None, baseline_path=None, root=None,
                     report.suppressed.append(f)
             module_facts.append(
                 _dataflow._ModuleFacts.from_dict(hit["facts"]))
+            conc_facts.append(
+                _conc._CModuleFacts.from_dict(hit["cfacts"]))
             _add_stub_module(proj, relpath, hit["stub"])
             continue
         try:
@@ -552,14 +556,24 @@ def run_check(paths, rules=None, baseline_path=None, root=None,
     for mod in parsed_modules:
         mf = _dataflow.extract_module_facts(proj, mod)
         module_facts.append(mf)
+        cf = _conc.extract_module_facts(proj, mod)
+        conc_facts.append(cf)
         entry = cache_entries_pending[mod.path]
         entry["facts"] = mf.to_dict()
+        entry["cfacts"] = cf.to_dict()
         cache.put(mod.path, entry)
 
-    # Phase B: the interprocedural fixpoint + flow findings.
+    # Phase B: the interprocedural fixpoint + flow findings. The
+    # concurrency findings chain through the same routing so allow
+    # lists, suppressions, ``--rules`` filters, and the baseline apply
+    # identically.
+    raw_flow = []
     if want_flow and module_facts:
-        for rule_id, path, lineno, message in _flow.run_flow_analysis(
-                module_facts):
+        raw_flow.extend(_flow.run_flow_analysis(module_facts))
+    if want_flow and conc_facts:
+        raw_flow.extend(_conc.run_concurrency_analysis(conc_facts))
+    if raw_flow:
+        for rule_id, path, lineno, message in raw_flow:
             rule = flow_rules_by_id.get(rule_id)
             if rule is None or rule_id not in selected_ids:
                 continue

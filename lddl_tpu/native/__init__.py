@@ -105,12 +105,21 @@ def _load():
         if os.environ.get("LDDL_TPU_DISABLE_NATIVE"):
             return None
         from .build import ensure_built
+        # ensure_built routes by LDDL_TPU_NATIVE_SANITIZE: a sanitized
+        # build lives under its own mode-suffixed .so and never collides
+        # with the normal cache.
         path = ensure_built()
         if path is None:
             return None
         try:
             lib = ctypes.CDLL(path)
         except OSError:
+            # Includes the sanitized-build case where the sanitizer
+            # runtime is not preloaded: dlopen'ing a TSan/ASan .so into
+            # plain CPython requires LD_PRELOAD=libtsan.so/libasan.so
+            # (benchmarks/sanitize_smoke.py sets this up). Degrading to
+            # "unavailable" here is correct — the smoke separately
+            # asserts availability so it can never pass vacuously.
             return None
         # Version-gate BEFORE binding symbols: a cached .so from an older
         # ABI must degrade to "unavailable", not raise AttributeError.

@@ -18,12 +18,77 @@ from ..resilience.io import atomic_publish, atomic_write
 _DIR = os.path.dirname(os.path.abspath(__file__))
 SRC = os.path.join(_DIR, "lddl_native.cpp")
 TABLES = os.path.join(_DIR, "unicode_tables.h")
+# Paths of the NORMAL (unsanitized) build; sanitized builds live under
+# mode-suffixed names (lib_path) so the two can never collide.
 LIB = os.path.join(_DIR, "_lddl_native.so")
 LIB_META = LIB + ".meta"
+
+_SANITIZE_FLAGS = {
+    "tsan": ("-fsanitize=thread",),
+    "asan": ("-fsanitize=address",),
+    "ubsan": ("-fsanitize=undefined",),
+}
 
 
 def _march():
     return os.environ.get("LDDL_TPU_NATIVE_MARCH", "native")
+
+
+def sanitize_modes():
+    """Sanitizer modes requested via LDDL_TPU_NATIVE_SANITIZE (comma
+    separated subset of tsan/asan/ubsan), as a sorted tuple. () means a
+    normal build. Invalid values raise — a typo silently building an
+    uninstrumented kernel would make the CI sanitize smoke vacuous."""
+    raw = os.environ.get("LDDL_TPU_NATIVE_SANITIZE", "").strip()
+    if not raw:
+        return ()
+    modes = sorted({m.strip() for m in raw.split(",") if m.strip()})
+    bad = [m for m in modes if m not in _SANITIZE_FLAGS]
+    if bad:
+        raise ValueError(
+            "LDDL_TPU_NATIVE_SANITIZE={!r}: unknown mode(s) {}; expected "
+            "a comma-separated subset of {}".format(
+                raw, bad, "/".join(sorted(_SANITIZE_FLAGS))))
+    if "tsan" in modes and "asan" in modes:
+        raise ValueError(
+            "LDDL_TPU_NATIVE_SANITIZE: tsan and asan are mutually "
+            "exclusive (gcc cannot combine their runtimes)")
+    return tuple(modes)
+
+
+def lib_path(modes=None):
+    """The .so path for the requested sanitizer modes. Sanitized builds
+    get their own cache key (filename) so toggling
+    LDDL_TPU_NATIVE_SANITIZE can never serve a binary built for the
+    other mode."""
+    modes = sanitize_modes() if modes is None else tuple(modes)
+    if not modes:
+        return LIB
+    return os.path.join(_DIR, "_lddl_native.san-{}.so".format(
+        "-".join(modes)))
+
+
+def compile_flags(modes=None):
+    """The exact g++ flags for this build (part of the staleness meta
+    tag, so a flag change — including the sanitizer set — rebuilds even
+    against an mtime-equal cached .so).
+
+    -march=native: the engine builds lazily on the machine that runs it
+    (2x on the WordPiece/UTF-8 hot loops vs plain -O3); heterogeneous
+    fleets sharing one prebuilt image pin LDDL_TPU_NATIVE_MARCH.
+    -pthread: the v8 engine runs an in-kernel thread pool. Sanitized
+    builds trade -O3 for -O1 -g -fno-omit-frame-pointer so TSan/ASan
+    reports carry usable stacks and races are not optimized away."""
+    modes = sanitize_modes() if modes is None else tuple(modes)
+    flags = ["-march=" + _march(), "-std=c++17",
+             "-shared", "-fPIC", "-pthread"]
+    if modes:
+        flags = ["-O1", "-g", "-fno-omit-frame-pointer"] + flags
+        for m in modes:
+            flags.extend(_SANITIZE_FLAGS[m])
+    else:
+        flags = ["-O3"] + flags
+    return flags
 
 
 def source_digest():
@@ -52,9 +117,15 @@ def _lib_meta_tag():
     check. 'native' is intentionally not resolved to a concrete ISA: two
     heterogeneous hosts sharing a tree should pin LDDL_TPU_NATIVE_MARCH.
     The tag also embeds a digest of the kernel sources (source_digest),
-    so content drift rebuilds even when mtimes lie."""
+    so content drift rebuilds even when mtimes lie, PLUS the sanitizer
+    mode set and the full compiler flag list, so toggling
+    LDDL_TPU_NATIVE_SANITIZE (or any flag change) can never serve a
+    stale cached .so."""
     import platform
-    tag = "march=" + _march() + ";src=" + source_digest()
+    modes = sanitize_modes()
+    tag = ("march=" + _march() + ";src=" + source_digest()
+           + ";sanitize=" + (",".join(modes) or "off")
+           + ";flags=" + " ".join(compile_flags(modes)))
     if _march() == "native":
         tag += ";host=" + platform.machine()
         # A concrete per-microarch signal where available (x86 flags set
@@ -82,10 +153,11 @@ def _stale(target, sources):
 
 
 def _lib_stale():
-    if _stale(LIB, [SRC, TABLES]):
+    lib = lib_path()
+    if _stale(lib, [SRC, TABLES]):
         return True
     try:
-        with open(LIB_META) as f:
+        with open(lib + ".meta") as f:
             return f.read().strip() != _lib_meta_tag()
     except OSError:
         return True
@@ -132,10 +204,11 @@ def _build_lock():
 
 
 def ensure_built(verbose=False):
-    """Build (if stale) and return the .so path, or None on failure."""
+    """Build (if stale) and return the .so path for the current
+    LDDL_TPU_NATIVE_SANITIZE mode set, or None on failure."""
     try:
         if not _tables_stale() and not _lib_stale():
-            return LIB
+            return lib_path()
         with _build_lock():
             # Re-check under the lock: another process may have finished.
             if _tables_stale():
@@ -152,18 +225,7 @@ def ensure_built(verbose=False):
                 fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
                 os.close(fd)
                 try:
-                    # -march=native: the engine builds lazily on the
-                    # machine that runs it (2x on the WordPiece/UTF-8 hot
-                    # loops vs plain -O3). Heterogeneous fleets sharing
-                    # one prebuilt image can pin a baseline arch via
-                    # LDDL_TPU_NATIVE_MARCH (e.g. x86-64-v2); a host whose
-                    # arch tag mismatches the cached .so rebuilds instead
-                    # of SIGILL-ing (_lib_meta_tag in the staleness check).
-                    # -pthread: the v8 engine runs an in-kernel thread
-                    # pool (LDDL_TPU_NATIVE_THREADS).
-                    cmd = ["g++", "-O3", "-march=" + _march(), "-std=c++17",
-                           "-shared", "-fPIC", "-pthread",
-                           SRC, "-o", tmp]
+                    cmd = ["g++"] + compile_flags() + [SRC, "-o", tmp]
                     proc = subprocess.run(cmd, capture_output=True, text=True)
                     if proc.returncode != 0:
                         if verbose:
@@ -171,12 +233,13 @@ def ensure_built(verbose=False):
                         return None
                     # Durable atomic publish: on a shared tree (NFS,
                     # prebuilt image) a torn .so would SIGBUS every host.
-                    atomic_publish(tmp, LIB)
-                    atomic_write(LIB_META, _lib_meta_tag() + "\n")
+                    lib = lib_path()
+                    atomic_publish(tmp, lib)
+                    atomic_write(lib + ".meta", _lib_meta_tag() + "\n")
                 finally:
                     if os.path.exists(tmp):
                         os.unlink(tmp)
-        return LIB
+        return lib_path()
     except Exception as e:  # missing g++, read-only fs, ...
         if verbose:
             print("native build unavailable: {}".format(e))

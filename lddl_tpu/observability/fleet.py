@@ -361,7 +361,10 @@ def flush_events():
     try:
         from ..resilience import io as rio
         os.makedirs(d, exist_ok=True)
-        path = rotating_path(d, "events-pid", _ev_segment)
+        # rotating_path mutates the shared segment dict, and both the
+        # heartbeat thread and the SIGTERM/atexit flush reach here.
+        with _lock:
+            path = rotating_path(d, "events-pid", _ev_segment)
         payload = "".join(json.dumps(ev, sort_keys=True) + "\n"
                           for ev in batch)
         with rio.open_append(path) as f:
@@ -481,8 +484,11 @@ def ensure_started(interval=None):
     t = threading.Thread(target=loop, name="lddl-fleet-heartbeat",
                          daemon=True)
     t.start()
-    _hb["thread"] = t
-    _hb["stop"] = stop
+    # The heartbeat thread writes _hb["beats"] under _lock; publish the
+    # thread/stop handles under the same lock.
+    with _lock:
+        _hb["thread"] = t
+        _hb["stop"] = stop
 
 
 def _final_flush():
@@ -499,10 +505,11 @@ def _reset_for_tests():
         _ev_segment.clear()
         _ev_segment["path"] = None
         _hb["beats"] = 0
-    if _hb["stop"] is not None:
-        _hb["stop"].set()
-    _hb["thread"] = None
-    _hb["stop"] = None
+        stop = _hb["stop"]
+        _hb["thread"] = None
+        _hb["stop"] = None
+    if stop is not None:
+        stop.set()
     from . import series
     series._reset_for_tests()
 

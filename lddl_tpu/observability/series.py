@@ -197,7 +197,10 @@ def flush():
     try:
         from ..resilience import io as rio
         os.makedirs(d, exist_ok=True)
-        path = fleet.rotating_path(d, SEGMENT_PREFIX, _segment)
+        # rotating_path mutates the shared segment dict, and both the
+        # heartbeat sampler and the SIGTERM/atexit flush reach here.
+        with _lock:
+            path = fleet.rotating_path(d, SEGMENT_PREFIX, _segment)
         payload = "".join(json.dumps(p, sort_keys=True) + "\n"
                           for p in batch)
         with rio.open_append(path) as f:

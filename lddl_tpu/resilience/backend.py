@@ -114,7 +114,10 @@ def active_name():
 
 
 _instances = {}
-_instances_lock = threading.Lock()
+# Reentrant: get_backend sits on the SIGTERM flush path (final_flush ->
+# spool writes -> backend), and a signal interrupting a frame that holds
+# a non-reentrant lock here would deadlock the handler.
+_instances_lock = threading.RLock()
 
 
 def get_backend():

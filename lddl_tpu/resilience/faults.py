@@ -61,6 +61,7 @@ kill must leave only ``*.tmp.*`` debris + an unjournaled unit).
 import errno
 import os
 import random
+import threading
 import time
 
 ENV_VAR = "LDDL_TPU_FAULTS"
@@ -73,6 +74,10 @@ _ERRNO_OF = {
 # Parsed state: (raw_spec, [clause dicts]); counters are per-process and
 # per-clause. Re-parsed whenever the env var changes.
 _state = {"raw": None, "clauses": []}
+# The injector hooks run on whatever thread hits them (heartbeat
+# sampler, sink writer, main); reentrant so a signal interrupting a
+# frame mid-refresh cannot deadlock its own hook.
+_state_lock = threading.RLock()
 
 
 class FaultSpecError(ValueError):
@@ -132,22 +137,25 @@ def _parse(raw):
 
 def _refresh():
     raw = os.environ.get(ENV_VAR) or None
-    if raw != _state["raw"]:
-        _state["raw"] = raw
-        _state["clauses"] = _parse(raw)
-        for c in _state["clauses"]:
-            c["_calls"] = 0
-            c["_injected"] = 0
-            c["_rng"] = random.Random(c["seed"] * 1000003 + os.getpid())
-    return _state["clauses"]
+    with _state_lock:
+        if raw != _state["raw"]:
+            _state["raw"] = raw
+            _state["clauses"] = _parse(raw)
+            for c in _state["clauses"]:
+                c["_calls"] = 0
+                c["_injected"] = 0
+                c["_rng"] = random.Random(
+                    c["seed"] * 1000003 + os.getpid())
+        return _state["clauses"]
 
 
 def arm(spec):
     """Arm the injector for this process AND future child processes.
     Re-arming (even with an identical spec) resets the call counters."""
     os.environ[ENV_VAR] = spec
-    _state["raw"] = None  # force a re-parse so counters start fresh
-    _refresh()
+    with _state_lock:
+        _state["raw"] = None  # force a re-parse so counters start fresh
+        _refresh()
 
 
 def disarm():

@@ -53,6 +53,9 @@ def test_every_rule_is_registered_once():
         # project-scope (interprocedural flow) rules — tests/test_dataflow.py
         "wall-clock-flow", "rng-flow", "fs-order-flow",
         "publish-path-flow", "lease-isolation",
+        # concurrency rules — tests/test_concurrency_rules.py
+        "thread-escape", "lock-order", "signal-safety",
+        "env-read-after-spawn",
     }
 
 

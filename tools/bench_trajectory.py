@@ -134,6 +134,37 @@ def load_thread_scaling(repo_root):
     return out
 
 
+def load_static_analysis(repo_root):
+    """Finding count + per-rule tally from the lddl_check.sarif artifact
+    the ``tools/ci_check.sh --full`` gate writes, so the static-analysis
+    verdict shows up on the same status surface as perf and alerts. New
+    findings gate CI ("error" level); baselined ones ride along as
+    "note"/baselineState=unchanged. None when no artifact exists."""
+    path = os.path.join(repo_root, "lddl_check.sarif")
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        run = doc["runs"][0]
+    except (OSError, ValueError, KeyError, IndexError):
+        return None
+    new, baselined = 0, 0
+    by_rule = {}
+    for res in run.get("results", ()):
+        if res.get("baselineState") == "unchanged":
+            baselined += 1
+        else:
+            new += 1
+        rid = res.get("ruleId", "?")
+        by_rule[rid] = by_rule.get(rid, 0) + 1
+    return {
+        "new": new,
+        "baselined": baselined,
+        "by_rule": by_rule,
+        "rules_enabled": len(run.get("tool", {}).get("driver", {})
+                             .get("rules", ())),
+    }
+
+
 def load_coordination(repo_root):
     """The elastic coordination-cost and autoscale-episode blocks from
     SCALE_RUN.json (lease filesystem ops per unit, legacy vs batched;
@@ -246,6 +277,7 @@ def main(argv=None):
         "sink_overlap": load_sink_overlap(args.repo_root),
         "coordination": load_coordination(args.repo_root),
         "thread_scaling": load_thread_scaling(args.repo_root),
+        "static_analysis": load_static_analysis(args.repo_root),
     }
     if args.series_dir:
         result["live_rates"] = load_live_rates(args.series_dir, args.window)
@@ -345,6 +377,14 @@ def main(argv=None):
                       scale.get("decisions_total"),
                       scale.get("backlog_slo_docs"),
                       scale.get("helper_joined_generation")))
+    sa = result["static_analysis"]
+    if sa:
+        tally = ", ".join("{}={}".format(k, v)
+                          for k, v in sorted(sa["by_rule"].items()))
+        print("static analysis (lddl_check.sarif): {} new, {} baselined "
+              "finding(s) across {} rules{}".format(
+                  sa["new"], sa["baselined"], sa["rules_enabled"],
+                  "; by rule: " + tally if tally else ""))
     live = result.get("live_rates")
     if live:
         print("live rates (last {:.0f}s from {}):".format(

@@ -126,4 +126,20 @@ if [ "${LDDL_TPU_CI_SMOKE_NATIVE:-0}" = "1" ]; then
         -k "identity_smoke or mask_matches" -p no:cacheprovider
     echo "ci_check: native fused identity smoke passed"
 fi
+
+# Opt-in sanitizer smoke: rebuilds the kernel under TSan+UBSan (its own
+# mode-suffixed .so, so the normal build cache is untouched) and runs
+# the 1-vs-N entry-point identity suite against it. GATING when
+# requested: any sanitizer report, a failed instrumented build, or the
+# sanitized engine silently failing to load all exit nonzero. Opt-in
+# via LDDL_TPU_CI_SMOKE_SANITIZE=1 (instrumented build + TSan-slowed
+# suite costs minutes; the static gate itself must stay sub-second).
+if [ "${LDDL_TPU_CI_SMOKE_SANITIZE:-0}" = "1" ]; then
+    if JAX_PLATFORMS=cpu python benchmarks/sanitize_smoke.py; then
+        echo "ci_check: sanitize smoke passed (TSan+UBSan, zero reports)"
+    else
+        echo "ci_check: sanitize smoke FAILED — sanitizer report or instrumented build/load failure" >&2
+        exit 1
+    fi
+fi
 echo "ci_check: OK"

@@ -39,13 +39,16 @@ the one layer allowlisted for them); this tool only formats the report.
 
 import argparse
 import json
+import os
 import sys
 import time
 
 try:
     from tools.trace_summary import _table  # python -m tools.*
+    from tools.bench_trajectory import load_static_analysis
 except ImportError:  # direct script invocation: tools/ is sys.path[0]
     from trace_summary import _table
+    from bench_trajectory import load_static_analysis
 
 
 def _fmt_age(age):
@@ -225,6 +228,14 @@ def format_report(report):
                                           "trend"]))
         if gauge_rows:
             out.append(_table(gauge_rows, ["host", "gauge", "last", ""]))
+    sa = report.get("static_analysis")
+    if sa:
+        tally = ", ".join("{}={}".format(k, v)
+                          for k, v in sorted(sa["by_rule"].items()))
+        out.append("")
+        out.append("static analysis: {} new, {} baselined finding(s)"
+                   "{}".format(sa["new"], sa["baselined"],
+                               "; by rule: " + tally if tally else ""))
     alerts = report.get("alerts")
     if alerts:
         out.append("")
@@ -266,6 +277,10 @@ def run_once(args):
     # chaos/CI runs export LDDL_TPU_STORAGE_BACKEND into the whole
     # fleet, so the operator's status probe names the same store).
     report["storage_backend"] = storage.active_name()
+    # Static-analysis verdict from the ci_check --full SARIF artifact, so
+    # the operator sees the gate on the same surface as perf and alerts.
+    report["static_analysis"] = load_static_analysis(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     if args.alerts:
         from lddl_tpu.observability import alerts as alerts_mod
         report["alerts"] = alerts_mod.evaluate_file(
