@@ -1,0 +1,158 @@
+"""CI smoke for the loader shard-I/O pipeline: one tiny dataset built
+on the MockObjectStore, then streamed through the real BERT loader
+three times — synchronous baseline (``LDDL_TPU_LOADER_PREFETCH_SHARDS=0``
+``LDDL_TPU_LOADER_CACHE_BYTES=0``), prefetch+cache cold, and
+prefetch+cache warm (second pass over the same shared cache) — with
+per-op store latency injected so the pipeline actually has something
+to hide.
+
+Run by ``tools/ci_check.sh`` under ``LDDL_TPU_CI_SMOKE_BENCH=1``. The
+byte-identity half is GATING: prefetch depth and cache budget are
+*scheduling* knobs and must never change a single delivered tensor
+byte (the invariant tests/test_shardcache.py pins per-layer; this
+smoke pins it across the assembled loader). The wall times / speedup
+are informational only — a 1-core CI box and a 10 ms injected latency
+are not the headline measurement (that is LOADER_BENCH.json's
+``cache_prefetch_speedup`` block). Prints one JSON line::
+
+    {"identical": true, "samples": n, "shards": N, "latency_ms": ...,
+     "wall_s": {"sync": ..., "prefetch_cold": ..., "prefetch_warm": ...},
+     "speedup_cold": ..., "speedup_warm": ...}
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+import bench  # noqa: E402
+
+_SHARDS = 8
+
+
+def _load_once(bal_dir, vocab):
+    """One full pass through the real loader; returns
+    (n_samples, digest-of-batch-tensors, wall_s). Identity is checked
+    on decoded tensors — the bytes training would consume — not on
+    shard files."""
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+
+    loader = get_bert_pretrain_data_loader(
+        bal_dir, vocab_file=vocab, batch_size=8, num_workers=0)
+    h = hashlib.sha256()
+    n = 0
+    t0 = time.perf_counter()
+    for batch in loader:
+        for key in sorted(batch):
+            h.update(key.encode())
+            h.update(bytes(memoryview(batch[key]).cast("B")))
+        n += int(batch["input_ids"].shape[0])
+    return n, h.hexdigest(), time.perf_counter() - t0
+
+
+def _leg(bal_dir, vocab, prefetch_env):
+    """Run one loader leg with the given pipeline env overrides applied
+    for the duration of the pass only."""
+    saved = {}
+    for key, value in prefetch_env.items():
+        saved[key] = os.environ.pop(key, None)
+        if value is not None:
+            os.environ[key] = value
+    try:
+        return _load_once(bal_dir, vocab)
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def main():
+    target_mb = float(os.environ.get("LDDL_TPU_CACHE_SMOKE_MB", "0.5"))
+    latency_ms = float(os.environ.get("LDDL_TPU_CACHE_SMOKE_LATENCY_MS",
+                                      "10"))
+    tmp = tempfile.mkdtemp(prefix="lddl_cache_smoke_")
+    # Both knobs must be pinned BEFORE the first touch of the store:
+    # backend instances are cached per process and the mock store reads
+    # its latency once at construction.
+    os.environ["LDDL_TPU_STORAGE_BACKEND"] = "mock"
+    os.environ["LDDL_TPU_MOCK_LATENCY_MS"] = str(latency_ms)
+    try:
+        from lddl_tpu.balance import balance_shards
+        from lddl_tpu.preprocess import (BertPretrainConfig,
+                                         build_wordpiece_vocab,
+                                         get_tokenizer,
+                                         run_bert_preprocess)
+        from lddl_tpu.utils.cpus import usable_cpu_count
+
+        corpus = os.path.join(tmp, "corpus")
+        bench.make_corpus(corpus, target_mb, seed=0)
+        sample = []
+        sample_bytes = 0
+        with open(os.path.join(corpus, "source", "0.txt"),
+                  encoding="utf-8") as f:
+            for line in f:
+                sample.append(line.split(None, 1)[1])
+                sample_bytes += len(line)
+                if sample_bytes > 300_000:
+                    break
+        vocab = build_wordpiece_vocab(
+            sample, os.path.join(tmp, "vocab.txt"), vocab_size=8000)
+
+        pre = os.path.join(tmp, "pre")
+        bal = os.path.join(tmp, "bal")
+        run_bert_preprocess(
+            {"wikipedia": corpus}, pre, get_tokenizer(vocab_file=vocab),
+            config=BertPretrainConfig(max_seq_length=128,
+                                      duplicate_factor=1, masking=True,
+                                      schema_version=2),
+            num_blocks=_SHARDS, seed=7, bin_size=None,
+            num_workers=usable_cpu_count())
+        balance_shards(pre, bal, _SHARDS)
+
+        n_sync, d_sync, t_sync = _leg(
+            bal, vocab, {"LDDL_TPU_LOADER_PREFETCH_SHARDS": "0",
+                         "LDDL_TPU_LOADER_CACHE_BYTES": "0"})
+        n_cold, d_cold, t_cold = _leg(
+            bal, vocab, {"LDDL_TPU_LOADER_PREFETCH_SHARDS": None,
+                         "LDDL_TPU_LOADER_CACHE_BYTES": None})
+        # Same env, same process: the shared shard cache built during
+        # the cold pass is still resident — this IS the warm epoch.
+        n_warm, d_warm, t_warm = _leg(
+            bal, vocab, {"LDDL_TPU_LOADER_PREFETCH_SHARDS": None,
+                         "LDDL_TPU_LOADER_CACHE_BYTES": None})
+
+        report = {
+            "identical": (n_sync > 0 and n_sync == n_cold == n_warm
+                          and d_sync == d_cold == d_warm),
+            "samples": n_sync,
+            "shards": _SHARDS,
+            "latency_ms": latency_ms,
+            "wall_s": {"sync": round(t_sync, 2),
+                       "prefetch_cold": round(t_cold, 2),
+                       "prefetch_warm": round(t_warm, 2)},
+            "speedup_cold": round(t_sync / max(t_cold, 1e-9), 2),
+            "speedup_warm": round(t_sync / max(t_warm, 1e-9), 2),
+        }
+        print(json.dumps(report, sort_keys=True))
+        if not report["identical"]:
+            print("cache smoke: prefetch/cache changed delivered bytes "
+                  "(sync {} cold {} warm {})".format(d_sync, d_cold,
+                                                     d_warm),
+                  file=sys.stderr)
+            return 1
+        return 0
+    finally:
+        os.environ.pop("LDDL_TPU_STORAGE_BACKEND", None)
+        os.environ.pop("LDDL_TPU_MOCK_LATENCY_MS", None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -111,6 +111,23 @@ def attach_args():
                         "corrupt shard(s); quarantine = exclude them "
                         "loudly and run on the survivors (default: "
                         "$LDDL_TPU_ON_CORRUPT, then fail)")
+    p.add_argument("--storage-backend", choices=("local", "mock"),
+                   default=None,
+                   help="route shard I/O through this StorageBackend "
+                        "(default: inherit LDDL_TPU_STORAGE_BACKEND)")
+    p.add_argument("--backend-latency-ms", type=float, default=None,
+                   help="inject this per-operation latency into the mock "
+                        "object store (LDDL_TPU_MOCK_LATENCY_MS) — the "
+                        "first-class knob behind loader_bench's "
+                        "cache_prefetch_speedup pair")
+    p.add_argument("--prefetch-shards", type=int, default=None,
+                   help="loader shard read-ahead depth "
+                        "(LDDL_TPU_LOADER_PREFETCH_SHARDS; 0 disables "
+                        "the shard I/O pipeline)")
+    p.add_argument("--cache-bytes", type=int, default=None,
+                   help="loader shard-cache byte budget "
+                        "(LDDL_TPU_LOADER_CACHE_BYTES; 0 disables "
+                        "caching)")
     p.add_argument("--metrics-dir", default=None,
                    help="arm lddl_tpu.observability and write metric "
                         "snapshots (.jsonl), a Prometheus textfile, "
@@ -227,6 +244,20 @@ def main():
 
     if args.metrics_dir:
         obs.configure(dir=args.metrics_dir, periodic=True)
+
+    # Shard I/O knobs resolve to the env BEFORE any loader (and so any
+    # backend instance or prefetch thread) is built: the mock store
+    # caches its latency knob at construction, and the shard pipeline
+    # resolves its depth/budget per stream.
+    if args.storage_backend:
+        os.environ["LDDL_TPU_STORAGE_BACKEND"] = args.storage_backend
+    if args.backend_latency_ms is not None:
+        os.environ["LDDL_TPU_MOCK_LATENCY_MS"] = str(args.backend_latency_ms)
+    if args.prefetch_shards is not None:
+        os.environ["LDDL_TPU_LOADER_PREFETCH_SHARDS"] = \
+            str(args.prefetch_shards)
+    if args.cache_bytes is not None:
+        os.environ["LDDL_TPU_LOADER_CACHE_BYTES"] = str(args.cache_bytes)
 
     offline_shape = None
     packed = False
@@ -438,7 +469,15 @@ def main():
                                   batch_time.avg * 1e3))
                 t0 = time.perf_counter()
             total_samples += epoch_samples
-            total_wall += time.perf_counter() - epoch_t0
+            epoch_wall = time.perf_counter() - epoch_t0
+            total_wall += epoch_wall
+            # Per-epoch sustained rate: epoch 0 is the cold-cache pass,
+            # later epochs show the warm shard cache (loader_bench's
+            # warm_epoch criterion parses these lines).
+            print("epoch {} sustained: {:.1f} samples/s ({} samples / "
+                  "{:.2f} s)".format(epoch,
+                                     epoch_samples / max(epoch_wall, 1e-9),
+                                     epoch_samples, epoch_wall))
 
     total_tokens = sum(k * v for k, v in seq_len_hist.counts.items())
     total_pad = sum(pad_hist.counts.values())

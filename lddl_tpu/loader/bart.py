@@ -241,7 +241,10 @@ def get_bart_pretrain_data_loader(
 ):
     """BART denoising loader over ``{sentences}`` shards at ``path``.
     ``on_corrupt``: startup shard-integrity policy, see
-    get_bert_pretrain_data_loader."""
+    get_bert_pretrain_data_loader. Shard bytes arrive through the same
+    shard I/O pipeline as the BERT loader (loader/shardcache.py:
+    StorageBackend-routed reads, prefetch + generation-keyed cache +
+    decode-ahead; byte-identical with the pipeline on or off)."""
     import logging
     if tokenizer is None:
         from ..preprocess.tokenizer import get_tokenizer

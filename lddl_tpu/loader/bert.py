@@ -888,6 +888,14 @@ def get_bert_pretrain_data_loader(
     process — derive them from a device mesh with
     ``lddl_tpu.loader.process_dp_info(mesh)``. All processes in the same
     group receive identical batches (ref: lddl/torch_mp/bert.py:203-211).
+
+    Shard I/O: worker streams acquire shards through the loader shard
+    I/O pipeline (loader/shardcache.py) — StorageBackend-routed reads
+    with depth-K read-ahead prefetch (``LDDL_TPU_LOADER_PREFETCH_SHARDS``),
+    a generation-keyed read-through shard cache
+    (``LDDL_TPU_LOADER_CACHE_BYTES``), and decode-ahead. Batch bytes are
+    identical with the pipeline on or off; set both knobs to 0 for the
+    fully synchronous pre-pipeline path.
     """
     import logging
     if tokenizer is None:

@@ -206,14 +206,19 @@ class DataLoader:
         import os
         if os.environ.get("LDDL_TPU_FORCE_PROCESS_WORKERS"):
             return "process"  # tests / benchmarks of the mode itself
-        from ..utils.cpus import usable_cpu_count
-        ncpu = usable_cpu_count()
-        if ncpu < 2:
+        from ..utils.cpus import loader_io_threads, pool_cpu_budget
+        # Each worker process runs its own shard fetch/decode-ahead
+        # threads (loader/shardcache.py); budget for them so "spare
+        # cores" means cores actually left over, not the raw count.
+        io_threads = loader_io_threads()
+        budget = pool_cpu_budget(reserve=io_threads)
+        if budget < 2:
             logger = getattr(dataset, "logger", None)
-            msg = ("worker_mode='process' on a {}-CPU host: falling back "
-                   "to thread mode (process workers measured 40-240x "
-                   "slower without spare cores — LOADER_BENCH.json)"
-                   .format(ncpu))
+            msg = ("worker_mode='process' with a {}-CPU budget (usable "
+                   "cores minus {} shard-I/O thread(s) per stream): "
+                   "falling back to thread mode (process workers "
+                   "measured 40-240x slower without spare cores — "
+                   "LOADER_BENCH.json)".format(budget, io_threads))
             if logger is not None:
                 try:
                     logger.to("rank").warning(msg)

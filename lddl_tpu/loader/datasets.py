@@ -17,7 +17,6 @@ Determinism contract (ref: lddl/torch/datasets.py:227-286):
 import os
 
 from ..parallel.distributed import LocalCommunicator
-from ..resilience.io import read_table
 from ..utils import rng as lrng
 from ..utils.fs import (
     get_num_samples_of_parquet,
@@ -127,17 +126,14 @@ class ShuffleBuffer:
                     _s.inc(_pc() - t0, stage="decode")
                     yield sample
 
-        for f in self._files:
-            if self._logger is not None:
-                self._logger.to("worker").info("Reading {}".format(f.path))
-            # Resilient shard read: transient EIO/ESTALE retries with
-            # backoff instead of killing the epoch (resilience.io).
-            if obs_on:
-                t0 = pc()
-                table = read_table(f.path)
-                stage.inc(pc() - t0, stage="shard_read")
-            else:
-                table = read_table(f.path)
+        # Shard acquisition goes through the shard I/O pipeline
+        # (shardcache.shard_tables): StorageBackend-routed reads,
+        # read-ahead prefetch + generation-keyed cache + decode-ahead
+        # when enabled, the verbatim synchronous read_table path when
+        # not. Either way shards arrive in exactly self._files order,
+        # so the sample stream is byte-identical.
+        from .shardcache import shard_tables
+        for f, table in shard_tables(self._files, logger=self._logger):
             for record_batch in table.to_batches():
                 for sample in decode(record_batch):
                     if remaining <= 0:

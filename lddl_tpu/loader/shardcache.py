@@ -1,0 +1,387 @@
+"""Loader shard I/O pipeline: read-ahead prefetch + generation-keyed
+read-through shard cache + decode-ahead over the storage backend.
+
+The loader's shard order is fully deterministic before the epoch starts
+(seeded world shuffle -> dp-group stride -> worker stride, see
+:class:`..loader.datasets.ParquetDataset`), so read-ahead is EXACT —
+never speculative. This module exploits that three ways:
+
+1. **Prefetch** — a small pool of fetcher threads walks the worker's
+   shard list depth-K ahead of the consumer, pulling raw shard bytes
+   through ``resilience.io.read_shard_bytes`` (the StorageBackend seam).
+   Fetch indices are claimed from a shared counter but DELIVERED
+   strictly in file order, so batch bytes are independent of thread
+   scheduling. The depth bounds in-flight + undelivered shards, which
+   bounds memory.
+2. **Shard cache** — a process-wide read-through LRU over raw shard
+   bytes, keyed ``(path, version)`` where the version is the object's
+   commit generation on the mock store (the ETag) or a (size, mtime_ns)
+   stat pair on POSIX. Every lookup starts with a cheap
+   ``object_head`` version probe, so after ``maybe_refresh`` picks up a
+   new generation a pre-advance cache entry can never be served — the
+   key mismatch reads as a miss and refetches.
+3. **Decode-ahead** — one decode thread turns fetched bytes into Arrow
+   tables through a depth-1 queue (the preprocess sink's double buffer,
+   inverse direction), so parquet decode of shard N+1 overlaps
+   consumption of shard N.
+
+Byte identity: shards are consumed in exactly the order the synchronous
+path reads them and the bytes come from the same backend reads, so the
+sample stream is identical with the pipeline on or off (pinned by
+tests/test_shardcache.py and benchmarks/cache_smoke.py).
+
+Env knobs (resolved once per stream, BEFORE any worker thread spawns)::
+
+    LDDL_TPU_LOADER_PREFETCH_SHARDS  read-ahead depth K (default 4;
+                                     0 disables the threaded pipeline —
+                                     shards are read synchronously, and
+                                     on the local backend that path is
+                                     the pre-pipeline ``read_table``
+                                     code verbatim)
+    LDDL_TPU_LOADER_CACHE_BYTES      shard-cache budget in bytes
+                                     (default 256 MiB; 0 disables
+                                     caching)
+
+Telemetry (all inert on batch bytes, gated by ``observability.enabled``):
+``loader_shard_cache_{hits,misses,evictions}_total``,
+``loader_shard_cache_bytes`` (gauge),
+``loader_prefetch_shard_wait_seconds_total`` (consumer blocked waiting
+for a prefetched shard), and the ``shard_fetch`` attribution stage
+(fetch self-time on the prefetcher threads).
+"""
+
+import collections
+import os
+import queue
+import threading
+
+from .. import observability as obs
+from ..resilience import io as rio
+
+DEFAULT_PREFETCH_SHARDS = 4
+DEFAULT_CACHE_BYTES = 256 << 20
+# Concurrent backend fetches per stream: enough to overlap several
+# round trips of per-op latency, few enough that K streams (elastic
+# workers) don't swamp the box — cpus.loader_io_threads() folds this
+# into pool-sizing budgets.
+MAX_FETCH_THREADS = 4
+
+WAIT_METRIC = "loader_prefetch_shard_wait_seconds_total"
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def pipeline_config():
+    """(prefetch_depth, cache_budget_bytes) resolved from the env ONCE —
+    callers resolve before spawning any thread, so the analyzer's
+    env-read-after-spawn rule holds by construction."""
+    depth = max(0, _env_int("LDDL_TPU_LOADER_PREFETCH_SHARDS",
+                            DEFAULT_PREFETCH_SHARDS))
+    budget = max(0, _env_int("LDDL_TPU_LOADER_CACHE_BYTES",
+                             DEFAULT_CACHE_BYTES))
+    return depth, budget
+
+
+def io_thread_count(depth=None):
+    """Threads ONE loader stream adds at ``depth`` (default: the env
+    knob): the fetcher pool plus the decode-ahead thread; 0 when the
+    pipeline is disabled. Pool-sizing call sites subtract this so
+    elastic workers x loader threads never oversubscribe the affinity
+    mask."""
+    if depth is None:
+        depth = pipeline_config()[0]
+    if depth <= 0:
+        return 0
+    return min(depth, MAX_FETCH_THREADS) + 1
+
+
+class ShardCache:
+    """Process-wide read-through LRU over raw shard bytes, keyed
+    ``(path, version)``.
+
+    ``get`` starts with a version probe (``object_head`` — a commit-
+    record read on the mock store, a stat on POSIX; never data bytes),
+    so a republished object (new generation / changed stat) always
+    misses and refetches: generation-following can never be served a
+    stale shard. Fetches run OUTSIDE the lock — concurrent prefetch
+    threads fetch distinct shards in parallel — and insert-side
+    eviction keeps total bytes within the budget."""
+
+    def __init__(self, budget_bytes):
+        self._lock = threading.Lock()
+        self._entries = collections.OrderedDict()  # (path, version) -> bytes
+        self._bytes = 0
+        self._budget = int(budget_bytes)
+
+    @property
+    def budget_bytes(self):
+        return self._budget
+
+    def cached_bytes(self):
+        with self._lock:
+            return self._bytes
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, path):
+        """The CURRENT version of ``path``'s bytes, from cache when the
+        live version matches a cached key, from the backend otherwise."""
+        _, version = rio.object_head(path)
+        key = (path, version)
+        with self._lock:
+            data = self._entries.get(key)
+            if data is not None:
+                self._entries.move_to_end(key)
+        if data is not None:
+            if obs.enabled():
+                obs.inc("loader_shard_cache_hits_total")
+            return data
+        data, fetched_version = rio.read_shard_bytes(path)
+        self._insert(path, fetched_version, data)
+        if obs.enabled():
+            obs.inc("loader_shard_cache_misses_total")
+        return data
+
+    def _insert(self, path, version, data):
+        evicted = 0
+        with self._lock:
+            key = (path, version)
+            # An over-budget single shard is served but never cached; a
+            # racing duplicate fetch keeps the first copy.
+            if key not in self._entries and len(data) <= self._budget:
+                self._entries[key] = data
+                self._bytes += len(data)
+                while self._bytes > self._budget and self._entries:
+                    _, old = self._entries.popitem(last=False)
+                    self._bytes -= len(old)
+                    evicted += 1
+            size = self._bytes
+        if obs.enabled():
+            if evicted:
+                obs.inc("loader_shard_cache_evictions_total", evicted)
+            obs.set_gauge("loader_shard_cache_bytes", size)
+
+
+# Process-wide cache singleton, shared by every stream (thread-mode
+# workers, warm epochs) and rebuilt when the budget knob changes (tests
+# flip it). Same recognized guarded-singleton shape as
+# backend._instances.
+_cache = None
+_cache_lock = threading.Lock()
+
+
+def shared_cache(budget_bytes):
+    global _cache
+    with _cache_lock:
+        if _cache is None or _cache.budget_bytes != budget_bytes:
+            _cache = ShardCache(budget_bytes)
+        return _cache
+
+
+class _ShardStream:
+    """Depth-K ordered shard fetch + decode-ahead for one worker's file
+    list. Up to :data:`MAX_FETCH_THREADS` backend reads run concurrently
+    (one thread cannot hide per-op latency: sequential round trips
+    serialize), but results are handed to the single decode thread
+    strictly in file order, and the decode thread feeds the consumer
+    through a depth-1 queue."""
+
+    def __init__(self, files, depth, cache):
+        self._files = list(files)
+        self._depth = max(1, int(depth))
+        self._cache = cache
+        self._stop = threading.Event()
+        # One permit per undelivered in-flight shard: acquired before a
+        # fetch index is claimed, released when the decode thread takes
+        # delivery — bounds fetched-but-unconsumed bytes to depth shards.
+        self._slots = threading.Semaphore(self._depth)
+        self._cond = threading.Condition()
+        self._next_index = 0
+        self._results = {}
+        self._obs_on = obs.enabled()
+        self._stage = None
+        self._wait_counter = None
+        if self._obs_on:
+            from ..observability import attribution
+            self._stage = attribution.stage_counter()
+            self._wait_counter = obs.registry().counter(
+                WAIT_METRIC,
+                help="consumer wall seconds blocked waiting for a "
+                     "prefetched shard")
+        nthreads = min(self._depth, MAX_FETCH_THREADS,
+                       max(1, len(self._files)))
+        self._fetchers = [
+            threading.Thread(target=self._fetch_loop, daemon=True,
+                             name="lddl-shard-fetch-{}".format(i))
+            for i in range(nthreads)]
+        self._tables = queue.Queue(maxsize=1)
+        self._decoder = threading.Thread(target=self._decode_loop,
+                                         daemon=True,
+                                         name="lddl-shard-decode")
+
+    # ------------------------------------------------------------ fetch
+
+    def _fetch_one(self, path):
+        if self._cache is not None:
+            return self._cache.get(path)
+        data, _ = rio.read_shard_bytes(path)
+        return data
+
+    def _fetch_loop(self):
+        import time as _time
+        pc = _time.perf_counter
+        while not self._stop.is_set():
+            # Bounded acquire so an abandoned stream (consumer closed the
+            # generator early) never leaves a thread parked forever.
+            if not self._slots.acquire(timeout=0.1):
+                continue
+            with self._cond:
+                i = self._next_index
+                if i >= len(self._files):
+                    self._slots.release()
+                    return
+                self._next_index += 1
+            try:
+                t0 = pc() if self._obs_on else 0.0
+                out = ("ok", self._fetch_one(self._files[i].path))
+                if self._obs_on:
+                    self._stage.inc(pc() - t0, stage="shard_fetch")
+            except BaseException as e:  # noqa: BLE001 - forwarded below
+                out = ("error", e)
+            with self._cond:
+                self._results[i] = out
+                self._cond.notify_all()
+
+    def _take_fetched(self, i):
+        """Decode-thread side of ordered delivery: block for index
+        ``i``, release its depth slot, re-raise forwarded errors."""
+        with self._cond:
+            while i not in self._results:
+                self._cond.wait(timeout=0.1)
+                if self._stop.is_set() and i not in self._results:
+                    raise RuntimeError("shard pipeline stopped")
+            out = self._results.pop(i)
+        self._slots.release()
+        if out[0] == "error":
+            raise out[1]
+        return out[1]
+
+    # ----------------------------------------------------------- decode
+
+    def _decode_loop(self):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        def put(item):
+            while not self._stop.is_set():
+                try:
+                    self._tables.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for i, f in enumerate(self._files):
+                data = self._take_fetched(i)
+                table = pq.read_table(pa.BufferReader(data))
+                if not put(("table", f, table)):
+                    return
+            put(("end", None, None))
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+            put(("error", None, e))
+
+    # ---------------------------------------------------------- consume
+
+    def __iter__(self):
+        import time as _time
+        pc = _time.perf_counter
+        for t in self._fetchers:
+            t.start()
+        self._decoder.start()
+        try:
+            while True:
+                t0 = pc() if self._obs_on else 0.0
+                kind, f, payload = self._tables.get()
+                if self._obs_on:
+                    dt = pc() - t0
+                    # The residual consumer-side blocking wait — what is
+                    # left of shard_read once fetch+decode run ahead.
+                    self._stage.inc(dt, stage="shard_read")
+                    self._wait_counter.inc(dt)
+                if kind == "error":
+                    raise payload
+                if kind == "end":
+                    return
+                yield f, payload
+        finally:
+            self._stop.set()
+            self._decoder.join(timeout=5)
+            for t in self._fetchers:
+                t.join(timeout=5)
+
+
+def _sync_tables(files, cache, logger):
+    """The pipeline-off path. Local backend + no cache is the
+    pre-pipeline ``read_table`` code verbatim (byte- and
+    syscall-identical); a non-local backend (or an armed cache) routes
+    the synchronous read through the versioned backend primitive so
+    every loader shard byte still crosses the StorageBackend seam."""
+    import time as _time
+    obs_on = obs.enabled()
+    stage = None
+    pc = _time.perf_counter
+    if obs_on:
+        from ..observability import attribution
+        stage = attribution.stage_counter()
+    use_backend = cache is not None or rio.backend_if_nonlocal() is not None
+    if use_backend:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+    for f in files:
+        if logger is not None:
+            logger.to("worker").info("Reading {}".format(f.path))
+        t0 = pc() if obs_on else 0.0
+        if use_backend:
+            data = (cache.get(f.path) if cache is not None
+                    else rio.read_shard_bytes(f.path)[0])
+            table = pq.read_table(pa.BufferReader(data))
+        else:
+            # Resilient shard read: transient EIO/ESTALE retries with
+            # backoff instead of killing the epoch (resilience.io).
+            table = rio.read_table(f.path)
+        if obs_on:
+            stage.inc(pc() - t0, stage="shard_read")
+        yield f, table
+
+
+def shard_tables(files, logger=None):
+    """Iterate ``(file, pyarrow.Table)`` over ``files`` in order through
+    the shard I/O pipeline — the loader's one shard-acquisition seam
+    (ShuffleBuffer consumes this). Pipeline knobs are resolved here,
+    before any thread spawns."""
+    depth, budget = pipeline_config()
+    cache = shared_cache(budget) if budget > 0 else None
+    if depth <= 0 or not files:
+        for item in _sync_tables(files, cache, logger):
+            yield item
+        return
+    stream = iter(_ShardStream(files, depth, cache))
+    try:
+        for f, table in stream:
+            if logger is not None:
+                logger.to("worker").info("Reading {}".format(f.path))
+            yield f, table
+    finally:
+        # Deterministic teardown on early consumer exit (ShuffleBuffer
+        # returns mid-epoch once its yield quota is met): closing the
+        # inner generator runs _ShardStream's stop/join finally NOW, not
+        # at GC time.
+        stream.close()

@@ -13,7 +13,13 @@ Stage vocabulary (``loader_stage_seconds_total{stage=...}``):
 ============== =========================================================
 self-time stages (pipeline work, mostly overlapped by worker threads)
 --------------------------------------------------------------------------
-``shard_read``  blocking parquet shard read (``read_table``)
+``shard_read``  consumer-side blocking shard acquisition: the synchronous
+                ``read_table`` when the shard I/O pipeline is off, or the
+                residual wait for the next prefetched+decoded table when
+                it is on (loader/shardcache.py)
+``shard_fetch`` backend shard-byte fetch self-time on the prefetcher
+                threads (mostly overlapped; large vs small ``shard_read``
+                is the prefetch-working/not-working signal)
 ``decode``      Arrow record-batch -> sample dict decode
 ``collate``     sample list -> padded/packed batch assembly
 ``ipc``         process-mode queue wait + payload decode (qserde)
@@ -47,8 +53,10 @@ STAGE_METRIC = "loader_stage_seconds_total"
 VERDICT_GAUGE = "loader_bound_verdict"
 INPUT_SHARE_GAUGE = "loader_input_share"
 
-# Self-time stages, in the order the batch path visits them.
-STAGES = ("shard_read", "decode", "collate", "ipc", "h2d")
+# Self-time stages, in the order the batch path visits them
+# (shard_fetch runs on the prefetcher threads, logically ahead of the
+# consumer's shard_read wait).
+STAGES = ("shard_fetch", "shard_read", "decode", "collate", "ipc", "h2d")
 
 INPUT_BOUND_SHARE = 0.40
 COMPUTE_BOUND_SHARE = 0.15

@@ -227,11 +227,18 @@ def pack_shape_of_schema(schema):
 
 def pack_shape_of_parquet(path):
     """Packed row shape off one shard's footer, or None (unreadable
-    footers are the integrity verifier's problem, not the sniffer's)."""
+    footers are the integrity verifier's problem, not the sniffer's).
+    On a non-local storage backend the footer arrives via ranged reads
+    (utils/fs), so the shape sniff never fetches a full object."""
     import pyarrow.parquet as pq
+    from ..resilience.io import backend_if_nonlocal
     try:
+        if backend_if_nonlocal() is not None:
+            from ..utils.fs import read_footer_metadata
+            return pack_shape_of_schema(read_footer_metadata(path).schema
+                                        .to_arrow_schema())
         return pack_shape_of_schema(pq.read_schema(path))
-    except (OSError, pa.ArrowInvalid):
+    except (OSError, RuntimeError, pa.ArrowInvalid):
         return None
 
 

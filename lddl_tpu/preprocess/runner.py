@@ -1163,11 +1163,14 @@ def _run_pipeline_body(corpus_paths, out_dir, process_bucket, num_blocks,
     workers = max(1, int(num_workers or 1))
     # Size the in-kernel thread pool so workers x native threads never
     # oversubscribes the usable cores; spawn children inherit this env and
-    # resolve their own budget from it (native.resolve_threads). setdefault
+    # resolve their own budget from it (native.resolve_threads). The
+    # budget reserves the loader shard-I/O threads (prefetch/decode-ahead,
+    # loader/shardcache.py) a colocated trainer's streams run. setdefault
     # only — an operator-set LDDL_TPU_NATIVE_THREADS always wins.
-    from ..utils.cpus import usable_cpu_count
-    os.environ.setdefault("LDDL_TPU_NATIVE_THREADS",
-                          str(max(1, usable_cpu_count() // workers)))
+    from ..utils.cpus import loader_io_threads, pool_cpu_budget
+    os.environ.setdefault(
+        "LDDL_TPU_NATIVE_THREADS",
+        str(max(1, pool_cpu_budget(reserve=loader_io_threads()) // workers)))
     spec = {
         "global_shuffle": global_shuffle,
         "out_dir": out_dir,

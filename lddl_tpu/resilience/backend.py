@@ -61,6 +61,7 @@ import json
 import os
 import shutil
 import threading
+import time
 
 from . import faults
 from ..observability import inc as obs_inc
@@ -193,21 +194,64 @@ class LocalBackend(object):
 
     def get(self, path, start=None, length=None):
         from . import io as rio
-        data = rio.read_bytes(path)
-        if start is not None or length is not None:
-            lo = start or 0
-            data = data[lo:] if length is None else data[lo:lo + length]
+        if start is None and length is None:
+            return rio.read_bytes(path)
+        # Ranged read: seek + pread ONLY the requested window. The loader
+        # census reads parquet footers this way; slurping the whole shard
+        # and slicing (the old behavior) defeats the point of a ranged
+        # API on multi-MB objects.
+        faults.fault_point("open", path)
+        lo = start or 0
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            if length is None:
+                os.lseek(fd, lo, os.SEEK_SET)
+                chunks = []
+                while True:
+                    c = os.read(fd, 1 << 20)
+                    if not c:
+                        break
+                    chunks.append(c)
+                data = b"".join(chunks)
+            else:
+                data = os.pread(fd, length, lo)
+                # Short preads are legal (signals, NFS): keep reading.
+                while len(data) < length:
+                    more = os.pread(fd, length - len(data),
+                                    lo + len(data))
+                    if not more:
+                        break
+                    data += more
+        finally:
+            os.close(fd)
+        if faults.fault_point("range-read", path) == "truncate":
+            data = data[:max(0, len(data) // 2 - 1)]
+        count(self.name, "range-read", "ok")
         return data
 
     def get_versioned(self, path):
-        """(bytes, generation) of the current object, or (None, None)
-        when absent. POSIX files carry no generation; 0 stands in (the
-        local protocol never CAS-chains off it)."""
+        """(bytes, version) of the current object, or (None, None) when
+        absent. POSIX files carry no generation; the (size, mtime_ns)
+        stat pair stands in as a change-detecting version — the same one
+        ``head`` reports, so the loader shard cache's probe/fetch keys
+        agree. The local protocol never CAS-chains off it."""
         from . import io as rio
         try:
-            return rio.read_bytes(path), 0
+            st = os.stat(path)
+            return rio.read_bytes(path), (st.st_size, st.st_mtime_ns)
         except FileNotFoundError:
             return None, None
+
+    def head(self, path):
+        """(size_bytes, version) metadata probe without reading data
+        bytes — the loader shard cache's cheap version check. Returns
+        (None, None) when absent."""
+        try:
+            st = os.stat(path)
+        except FileNotFoundError:
+            return None, None
+        count(self.name, "head", "ok")
+        return st.st_size, (st.st_size, st.st_mtime_ns)
 
     def list(self, dirpath):
         try:
@@ -259,6 +303,21 @@ class MockObjectStore(object):
         except ValueError:
             self._part_bytes = 1 << 18
         self._part_bytes = max(1, self._part_bytes)
+        # Uniform per-operation latency (LDDL_TPU_MOCK_LATENCY_MS),
+        # modeling a remote store's round trip on every DATA op —
+        # get/put/list/delete, NOT head (metadata probes are HEAD-class
+        # requests, orders of magnitude cheaper than GETs on real
+        # stores). First-class knob for loader_bench's prefetch/cache
+        # headline, replacing hand-built LDDL_TPU_FAULTS slow specs.
+        try:
+            self._latency_s = max(0.0, float(os.environ.get(
+                "LDDL_TPU_MOCK_LATENCY_MS", 0)) / 1e3)
+        except ValueError:
+            self._latency_s = 0.0
+
+    def _lat(self):
+        if self._latency_s > 0.0:
+            time.sleep(self._latency_s)
 
     # ------------------------------------------------------------ layout
 
@@ -446,6 +505,7 @@ class MockObjectStore(object):
         rio._fsync_dir(path)
 
     def _put_once(self, path, chunks, expected_gen):
+        self._lat()
         action = faults.fault_point("cas-put", path)
         if action == "conflict":
             _conflict(self.name, path, "cas-put")
@@ -524,6 +584,7 @@ class MockObjectStore(object):
         Paths never written through the store (source corpora, spool
         scratch) fall back to the plain file: they are external,
         generation-less objects."""
+        self._lat()
         faults.fault_point("open", path)
         odir = self._obj_dir(path)
         cur = self._current_gen(odir)
@@ -551,6 +612,7 @@ class MockObjectStore(object):
         (None, None) when the path has never been committed — the read
         half of every CAS chain. External plain files are NOT versioned
         reads: the CAS namespace is store-managed objects only."""
+        self._lat()
         faults.fault_point("open", path)
         odir = self._obj_dir(path)
         cur = self._current_gen(odir)
@@ -562,6 +624,29 @@ class MockObjectStore(object):
         count(self.name, "get", "ok")
         return data, cur
 
+    def head(self, path):
+        """(size_bytes, generation) of the current committed object from
+        its commit record alone — no part reads, no data bytes; the
+        loader shard cache's cheap version/ETag probe. External
+        (never-committed) plain files report a stat version like
+        LocalBackend; (None, None) when absent. Deliberately NOT a
+        latency-modeled data op (see ``_lat``)."""
+        odir = self._obj_dir(path)
+        cur = self._current_gen(odir)
+        if cur is None:
+            try:
+                st = os.stat(path)
+            except FileNotFoundError:
+                return None, None
+            count(self.name, "head", "ok")
+            return st.st_size, ("stat", st.st_size, st.st_mtime_ns)
+        try:
+            meta = self._read_meta(odir, cur)
+        except (OSError, ValueError):
+            return None, None
+        count(self.name, "head", "ok")
+        return int(meta.get("size", 0)), cur
+
     def list(self, dirpath):
         """Sorted names of the directory's objects: committed store
         objects plus external plain files (hidden names and publish
@@ -570,6 +655,7 @@ class MockObjectStore(object):
         list-after-put staleness window, which callers must (and do)
         tolerate: listings are discovery hints, record reads are the
         truth."""
+        self._lat()
         try:
             names = sorted(os.listdir(dirpath))
         except (FileNotFoundError, NotADirectoryError):
@@ -601,6 +687,7 @@ class MockObjectStore(object):
         then the materialized view. Immediately consistent in the mock —
         real-store delete lag is modeled by the ``list`` staleness fault
         instead, which is where the pipeline would feel it."""
+        self._lat()
         odir = self._obj_dir(path)
         shutil.rmtree(odir, ignore_errors=True)
         try:

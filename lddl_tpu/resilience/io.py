@@ -326,6 +326,97 @@ def read_bytes(path, retries=True):
     return _read()
 
 
+def backend_if_nonlocal():
+    """Public alias of the hot-path dispatch check: the active non-POSIX
+    backend instance, or None under the default LocalBackend (the loader
+    shard pipeline uses it to keep the local+disabled path byte- and
+    syscall-identical to the pre-pipeline code)."""
+    return _mock_backend()
+
+
+def object_head(path):
+    """(size_bytes, version) of ``path`` through the active backend
+    WITHOUT reading data bytes — the loader shard cache's version/ETag
+    probe. The mock store answers from the newest commit record (the
+    generation IS the version); the local path answers from ``os.stat``
+    with the (size, mtime_ns) pair standing in as a change-detecting
+    version. (None, None) when absent."""
+    bk = _mock_backend()
+    if bk is not None:
+        return bk.head(path)
+    try:
+        st = os.stat(path)
+    except FileNotFoundError:
+        return None, None
+    return st.st_size, (st.st_size, st.st_mtime_ns)
+
+
+def read_range(path, start, length, retries=True):
+    """Ranged read of ``[start, start+length)`` through the active
+    backend — the ``range-read`` fault site on both. Exists for
+    footer-first parquet census reads: counting samples must never
+    fetch full objects."""
+
+    def _read():
+        bk = _mock_backend()
+        t0 = _lat_start()
+        data = (bk if bk is not None else _backend.get_backend()).get(
+            path, start=start, length=length)
+        _lat_end(t0, "range-read")
+        return data
+
+    if retries:
+        return with_retries(_read, desc="range read {}".format(path))
+    return _read()
+
+
+def read_shard_bytes(path, retries=True):
+    """(bytes, version) of a whole parquet shard through the active
+    backend — the loader shard cache's fetch primitive. The version
+    pairs with :func:`object_head` so generation-following can never
+    serve stale cached bytes: mock-store objects carry their commit
+    generation (the ETag), POSIX files a (size, mtime_ns) stat version.
+
+    Same failure contract as :func:`read_table`: torn shard bytes — an
+    injected ``truncate`` or a genuinely chopped object (the parquet
+    magic is checked at both ends) — surface as a permanent ValueError
+    naming the shard, and can never be silently decoded or cached."""
+
+    def _read():
+        bk = _mock_backend()
+        t0 = _lat_start()
+        if bk is not None:
+            data, version = bk.get_versioned(path)
+            if data is None:
+                # External (never-committed) plain file: generation-less.
+                # Fall back to the raw object with the same stat version
+                # shape head() reports for it.
+                st = os.stat(path)
+                data = bk.get(path)
+                version = ("stat", st.st_size, st.st_mtime_ns)
+        else:
+            faults.fault_point("open", path)
+            st = os.stat(path)
+            with open(path, "rb") as f:
+                data = f.read()
+            version = (st.st_size, st.st_mtime_ns)
+            if faults.fault_point("read", path) == "truncate":
+                data = data[:max(0, len(data) // 2 - 1)]
+            _backend.count("local", "get", "ok")
+        _lat_end(t0, "get")
+        if len(data) < 12 or data[:4] != b"PAR1" or data[-4:] != b"PAR1":
+            raise ValueError(
+                "injected truncated parquet read: {}".format(path)
+                if faults.armed() else
+                "torn parquet shard read ({} byte(s)): {}".format(
+                    len(data), path))
+        return data, version
+
+    if retries:
+        return with_retries(_read, desc="read shard {}".format(path))
+    return _read()
+
+
 def read_json(path, retries=True):
     """Read a small JSON record with transient-error retries: returns
     ``(value, "ok")``, ``(None, "missing")`` on ENOENT, or

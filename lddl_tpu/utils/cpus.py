@@ -18,3 +18,25 @@ def usable_cpu_count():
         return max(1, len(os.sched_getaffinity(0)))
     except (AttributeError, OSError):  # non-Linux / restricted proc
         return max(1, os.cpu_count() or 1)
+
+
+def loader_io_threads():
+    """Threads ONE loader worker stream adds for shard I/O when the
+    prefetch pipeline is enabled (fetcher pool + decode-ahead — see
+    loader/shardcache.py), 0 when ``LDDL_TPU_LOADER_PREFETCH_SHARDS=0``.
+    Sizing call sites subtract this via :func:`pool_cpu_budget` so
+    elastic workers x loader threads never oversubscribe the affinity
+    mask."""
+    try:
+        from ..loader.shardcache import io_thread_count
+    except ImportError:  # pragma: no cover - loader deps absent
+        return 0
+    return io_thread_count()
+
+
+def pool_cpu_budget(reserve=0):
+    """:func:`usable_cpu_count` minus ``reserve`` helper threads, floored
+    at 1 — the base every pool derives worker/thread counts from when
+    helper threads (loader shard fetch/decode-ahead) share the affinity
+    mask."""
+    return max(1, usable_cpu_count() - max(0, reserve))

@@ -127,8 +127,37 @@ def get_generation_of_path(root, path):
     return int(m.group(1)) if m else 0
 
 
+def read_footer_metadata(path):
+    """Parquet ``FileMetaData`` via footer-first ranged reads through
+    the active storage backend: an 8-byte tail probe (footer length +
+    magic), then the footer itself — on an object store, metadata
+    consumers (num_samples census, packed-shape sniff) never fetch full
+    shards. Retries happen inside read_range; implausible footer shapes
+    raise RuntimeError (callers wrap or treat as unreadable)."""
+    import pyarrow as pa
+
+    from ..resilience.io import object_head, read_range
+    size, _ = object_head(path)
+    if size is None:
+        raise FileNotFoundError(path)
+    if size < 12:
+        raise RuntimeError(
+            "parquet shard implausibly small ({} byte(s))".format(size))
+    tail = read_range(path, size - 8, 8)
+    if len(tail) != 8 or tail[4:8] != b"PAR1":
+        raise RuntimeError("bad parquet footer magic")
+    footer_len = int.from_bytes(tail[:4], "little")
+    if footer_len <= 0 or footer_len + 8 > size:
+        raise RuntimeError(
+            "implausible parquet footer length {}".format(footer_len))
+    foot = read_range(path, size - 8 - footer_len, footer_len + 8)
+    return pq.read_metadata(pa.BufferReader(foot))
+
+
 def get_num_samples_of_parquet(path):
-    """Number of rows in a parquet shard, from metadata (no data read).
+    """Number of rows in a parquet shard, from metadata (no data read —
+    footer-first ranged reads when a non-local storage backend is
+    active, so the census never fetches full objects).
 
     Transient storage errors retry (resilience.io); a corrupt/truncated
     footer raises a ValueError that NAMES the shard instead of a bare
@@ -140,6 +169,9 @@ def get_num_samples_of_parquet(path):
             # Falls into the named-ValueError wrap below, like a real
             # torn footer would.
             raise RuntimeError("injected truncated footer read")
+        from ..resilience.io import backend_if_nonlocal
+        if backend_if_nonlocal() is not None:
+            return read_footer_metadata(path).num_rows
         return pq.ParquetFile(path).metadata.num_rows
 
     try:

@@ -198,8 +198,11 @@ def test_local_backend_interface_parity(tmp_path):
     bk.put_atomic(p, b"v1")
     assert bk.get(p) == b"v1"
     assert bk.get(p, start=1, length=1) == b"1"
-    assert bk.get_versioned(p) == (b"v1", 0)
+    st = os.stat(p)
+    assert bk.get_versioned(p) == (b"v1", (st.st_size, st.st_mtime_ns))
+    assert bk.head(p) == (st.st_size, (st.st_size, st.st_mtime_ns))
     assert bk.get_versioned(str(tmp_path / "absent")) == (None, None)
+    assert bk.head(str(tmp_path / "absent")) == (None, None)
     with pytest.raises(storage.CASConflict):
         bk.put_if_match(p, b"x", None)  # exists: create refused
     with pytest.raises(NotImplementedError):

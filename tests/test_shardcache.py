@@ -1,0 +1,373 @@
+"""Loader shard-I/O pipeline (lddl_tpu/loader/shardcache.py): ranged
+backend reads, the generation-keyed read-through shard cache, prefetch
+byte identity across backends and worker modes, and the fault-contract
+plumbing through the threaded path.
+
+The one invariant everything here pins: prefetch depth and cache budget
+are SCHEDULING knobs — they must never change a delivered byte, only
+when it was fetched.
+"""
+
+import hashlib
+import os
+import threading
+
+import pytest
+
+from lddl_tpu import observability as obs
+from lddl_tpu.loader import shardcache
+from lddl_tpu.resilience import backend as storage
+from lddl_tpu.resilience import faults
+from lddl_tpu.resilience import io as rio
+from lddl_tpu.utils.types import File
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    faults.disarm()
+    yield
+    faults.disarm()
+
+
+@pytest.fixture
+def mock_bk(monkeypatch):
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    return storage.get_backend()
+
+
+def _metrics(monkeypatch, tmp_path):
+    monkeypatch.setenv("LDDL_TPU_METRICS_DIR", str(tmp_path / "metrics"))
+    obs.registry().reset()
+    return obs.registry()
+
+
+def _parquet_bytes(values):
+    """Real (tiny) parquet bytes for column A=values."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    sink = pa.BufferOutputStream()
+    pq.write_table(pa.table({"A": [str(v) for v in values]}), sink)
+    return sink.getvalue().to_pybytes()
+
+
+def _write_shards(root, n_shards, rows_per_shard=8):
+    """n_shards local parquet files with distinct payloads; returns the
+    File list the loader-side API consumes."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    files = []
+    for i in range(n_shards):
+        p = os.path.join(str(root), "shard-{}.parquet".format(i))
+        pq.write_table(
+            pa.table({"A": ["s{}r{}".format(i, r)
+                            for r in range(rows_per_shard)]}), p)
+        files.append(File(p, rows_per_shard))
+    return files
+
+
+def _column(table):
+    return table.column("A").to_pylist()
+
+
+# ---------------------------------------------------- ranged local reads
+
+
+def test_local_ranged_get_reads_only_the_range(tmp_path, monkeypatch):
+    """LocalBackend.get(start, length) must seek+read just the range —
+    never fall back to a whole-file read (the footer census depends on
+    this staying O(footer), not O(shard))."""
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    p = str(tmp_path / "blob")
+    payload = bytes(range(256)) * 8
+    with open(p, "wb") as f:
+        f.write(payload)
+
+    # Whole-file reads delegate to rio.read_bytes; the ranged path must
+    # not touch it.
+    def _no_full_read(path):
+        raise AssertionError("ranged get fell back to a full read")
+    monkeypatch.setattr(rio, "read_bytes", _no_full_read)
+
+    preads = []
+    real_pread = os.pread
+
+    def recording_pread(fd, n, offset):
+        preads.append((n, offset))
+        return real_pread(fd, n, offset)
+    monkeypatch.setattr(os, "pread", recording_pread)
+
+    bk = storage.get_backend()
+    assert bk.get(p, start=5, length=7) == payload[5:12]
+    assert sum(n for n, _ in preads) <= 7 + 0  # never asks past the range
+    assert all(off >= 5 for _, off in preads)
+    # Open-ended tail read stays ranged too (lseek+read loop).
+    assert bk.get(p, start=len(payload) - 3) == payload[-3:]
+    # And through the retry-wrapped io helper.
+    assert rio.read_range(p, 0, 4) == payload[:4]
+
+
+# -------------------------------------------------------- cache semantics
+
+
+def test_cache_generation_advance_never_serves_stale(mock_bk, tmp_path,
+                                                     monkeypatch):
+    _metrics(monkeypatch, tmp_path)
+    p = str(tmp_path / "obj.parquet")
+    v1 = _parquet_bytes(["old-1", "old-2"])
+    v2 = _parquet_bytes(["new-1", "new-2", "new-3"])
+    mock_bk.put_atomic(p, v1)
+
+    cache = shardcache.ShardCache(1 << 20)
+    assert cache.get(p) == v1          # miss -> fetch+insert
+    assert cache.get(p) == v1          # hit
+    mock_bk.put_atomic(p, v2)          # generation advance (maybe_refresh)
+    assert cache.get(p) == v2          # version probe misses -> refetch
+    assert cache.get(p) == v2
+    reg = obs.registry()
+    assert reg.counter("loader_shard_cache_hits_total").value() == 2
+    assert reg.counter("loader_shard_cache_misses_total").value() == 2
+
+
+def test_cache_eviction_respects_budget_under_concurrent_gets(
+        tmp_path, monkeypatch):
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    _metrics(monkeypatch, tmp_path)
+    payloads = {}
+    for i in range(8):
+        p = str(tmp_path / "s{}.parquet".format(i))
+        payloads[p] = _parquet_bytes(["x{}y{}".format(i, r)
+                                      for r in range(20)])
+        with open(p, "wb") as f:
+            f.write(payloads[p])
+    one = len(next(iter(payloads.values())))
+    budget = int(one * 3.5)  # room for 3 shards, never 4
+    cache = shardcache.ShardCache(budget)
+
+    errors = []
+
+    def worker(order):
+        try:
+            for p in order:
+                got = cache.get(p)
+                assert got == payloads[p]
+                assert cache.cached_bytes() <= budget
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    paths = sorted(payloads)
+    threads = [threading.Thread(target=worker,
+                                args=(paths[k:] + paths[:k],))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert cache.cached_bytes() <= budget
+    assert len(cache) <= 3
+    assert obs.registry().counter(
+        "loader_shard_cache_evictions_total").value() > 0
+    # An over-budget single shard is served but never pinned in cache.
+    small = shardcache.ShardCache(10)
+    p0 = paths[0]
+    assert small.get(p0) == payloads[p0]
+    assert small.cached_bytes() == 0
+
+
+# --------------------------------------------- pipeline = sync, bytewise
+
+
+def _tables_digest(files):
+    h = hashlib.sha256()
+    order = []
+    for f, table in shardcache.shard_tables(files):
+        order.append(f.path)
+        h.update(repr(_column(table)).encode())
+    return order, h.hexdigest()
+
+
+def _pipeline_env(monkeypatch, depth, cache_bytes):
+    monkeypatch.setenv("LDDL_TPU_LOADER_PREFETCH_SHARDS", str(depth))
+    monkeypatch.setenv("LDDL_TPU_LOADER_CACHE_BYTES", str(cache_bytes))
+
+
+def test_shard_tables_identity_local_and_mock(tmp_path, monkeypatch):
+    files = _write_shards(tmp_path, 6)
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    _pipeline_env(monkeypatch, 0, 0)
+    sync = _tables_digest(files)
+    _pipeline_env(monkeypatch, 3, 1 << 20)
+    assert _tables_digest(files) == sync      # pipeline on, cold cache
+    assert _tables_digest(files) == sync      # warm cache epoch
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    _pipeline_env(monkeypatch, 0, 0)
+    assert _tables_digest(files) == sync      # mock backend, sync
+    _pipeline_env(monkeypatch, 3, 2 << 20)
+    assert _tables_digest(files) == sync      # mock backend, pipelined
+
+
+def test_shard_tables_generation_pickup_through_cache(mock_bk, tmp_path,
+                                                      monkeypatch):
+    p = str(tmp_path / "gen.parquet")
+    mock_bk.put_atomic(p, _parquet_bytes(["gen1-a", "gen1-b"]))
+    files = [File(p, 2)]
+    _pipeline_env(monkeypatch, 2, 3 << 20)
+    [(_, t1)] = list(shardcache.shard_tables(files))
+    assert _column(t1) == ["gen1-a", "gen1-b"]
+    mock_bk.put_atomic(p, _parquet_bytes(["gen2-a"]))
+    [(_, t2)] = list(shardcache.shard_tables([File(p, 1)]))
+    assert _column(t2) == ["gen2-a"]  # cached gen-1 entry must not serve
+
+
+def test_sync_killswitch_is_plain_read_table(tmp_path, monkeypatch):
+    """Depth 0 + cache 0 on the local backend is the pre-pipeline code
+    path verbatim: one rio.read_table per shard, no threads, no backend
+    byte-plumbing."""
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    _pipeline_env(monkeypatch, 0, 0)
+    files = _write_shards(tmp_path, 2)
+    calls = []
+    real = rio.read_table
+
+    def recording(path, *a, **kw):
+        calls.append(path)
+        return real(path, *a, **kw)
+    monkeypatch.setattr(rio, "read_table", recording)
+    out = list(shardcache.shard_tables(files))
+    assert calls == [f.path for f in files]
+    assert [_column(t) for _, t in out] == [
+        ["s0r{}".format(r) for r in range(8)],
+        ["s1r{}".format(r) for r in range(8)]]
+
+
+def test_truncate_fault_surfaces_through_pipeline(tmp_path, monkeypatch):
+    """A torn read inside a prefetcher thread must surface to the
+    consumer as the same named ValueError the synchronous path raises —
+    not hang, not kill the thread silently."""
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    _pipeline_env(monkeypatch, 2, 0)
+    files = _write_shards(tmp_path, 3)
+    faults.arm("read:truncate:nth=1")
+    with pytest.raises(ValueError, match="injected truncated parquet"):
+        list(shardcache.shard_tables(files))
+
+
+def test_early_consumer_exit_leaks_no_threads(tmp_path, monkeypatch):
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    _pipeline_env(monkeypatch, 2, 0)
+    files = _write_shards(tmp_path, 6)
+    before = threading.active_count()
+    gen = shardcache.shard_tables(files)
+    next(gen)
+    gen.close()  # mid-epoch abandon (ShuffleBuffer quota met)
+    assert threading.active_count() == before
+
+
+# ------------------------------------------------- footer-ranged census
+
+
+def test_footer_census_is_ranged_only_on_mock(mock_bk, tmp_path,
+                                              monkeypatch):
+    from lddl_tpu.utils.fs import get_num_samples_of_parquet
+    p = str(tmp_path / "census.parquet")
+    mock_bk.put_atomic(p, _parquet_bytes(["r{}".format(i)
+                                          for i in range(37)]))
+
+    def _no_full_fetch(path):
+        raise AssertionError("census fetched full shard bytes")
+    monkeypatch.setattr(mock_bk, "get_versioned", _no_full_fetch)
+    real_get = mock_bk.get
+
+    def ranged_only(path, start=None, length=None):
+        assert start is not None or length is not None, \
+            "census issued a whole-object get"
+        return real_get(path, start=start, length=length)
+    monkeypatch.setattr(mock_bk, "get", ranged_only)
+    assert get_num_samples_of_parquet(p) == 37
+
+
+# ------------------------------------------------------ thread budgeting
+
+
+def test_io_thread_count_and_pool_budget(monkeypatch):
+    from lddl_tpu.utils.cpus import (loader_io_threads, pool_cpu_budget,
+                                     usable_cpu_count)
+    assert shardcache.io_thread_count(0) == 0
+    assert shardcache.io_thread_count(2) == 3   # 2 fetchers + decode
+    assert shardcache.io_thread_count(64) == \
+        shardcache.MAX_FETCH_THREADS + 1
+    monkeypatch.setenv("LDDL_TPU_LOADER_PREFETCH_SHARDS", "0")
+    assert loader_io_threads() == 0
+    monkeypatch.setenv("LDDL_TPU_LOADER_PREFETCH_SHARDS", "8")
+    assert loader_io_threads() == shardcache.MAX_FETCH_THREADS + 1
+    assert pool_cpu_budget() == usable_cpu_count()
+    assert pool_cpu_budget(reserve=usable_cpu_count() + 10) == 1
+
+
+# ------------------------------------- loader-level identity, both modes
+
+
+@pytest.fixture(scope="module")
+def small_pipeline(tmp_path_factory):
+    """A tiny corpus -> vocab -> preprocess -> balance, just enough for
+    loader-level identity digests."""
+    import numpy as np
+    root = tmp_path_factory.mktemp("shardcache_pipeline")
+    source = root / "corpus" / "source"
+    source.mkdir(parents=True)
+    words = ("alpha beta gamma delta epsilon zeta eta theta iota "
+             "kappa").split()
+    g = np.random.Generator(np.random.Philox(key=[0, 23]))
+    with open(source / "0.txt", "w") as f:
+        for d in range(40):
+            sents = [" ".join(words[int(g.integers(0, len(words)))]
+                              for _ in range(int(g.integers(4, 10))))
+                     .capitalize() + "." for _ in range(int(g.integers(2, 6)))]
+            f.write("doc-{} {}\n".format(d, " ".join(sents)))
+    from lddl_tpu.balance import balance_shards
+    from lddl_tpu.preprocess import (BertPretrainConfig,
+                                     build_wordpiece_vocab, get_tokenizer,
+                                     run_bert_preprocess)
+    vocab = build_wordpiece_vocab([" ".join(words)] * 3,
+                                  str(root / "vocab.txt"), vocab_size=300)
+    run_bert_preprocess(
+        {"wiki": str(root / "corpus")}, str(root / "pre"),
+        get_tokenizer(vocab_file=vocab),
+        config=BertPretrainConfig(max_seq_length=64, duplicate_factor=2,
+                                  masking=True),
+        num_blocks=4, sample_ratio=1.0, seed=0)
+    balance_shards(str(root / "pre"), str(root / "bal"), 4)
+    return {"bal": str(root / "bal"), "vocab": vocab}
+
+
+def _loader_digest(path, vocab, **kw):
+    from lddl_tpu.loader import get_bert_pretrain_data_loader
+    loader = get_bert_pretrain_data_loader(
+        path, vocab_file=vocab, batch_size=8, **kw)
+    h = hashlib.sha256()
+    n = 0
+    for batch in loader:
+        for key in sorted(batch):
+            h.update(key.encode())
+            h.update(bytes(memoryview(batch[key]).cast("B")))
+        n += int(batch["input_ids"].shape[0])
+    return n, h.hexdigest()
+
+
+@pytest.mark.parametrize("worker_mode", ["thread", "process"])
+def test_loader_identity_pipeline_on_off(small_pipeline, monkeypatch,
+                                         worker_mode):
+    monkeypatch.delenv(storage.ENV_VAR, raising=False)
+    kw = {"num_workers": 2, "worker_mode": worker_mode}
+    _pipeline_env(monkeypatch, 0, 0)
+    base = _loader_digest(small_pipeline["bal"], small_pipeline["vocab"],
+                          **kw)
+    assert base[0] > 0
+    _pipeline_env(monkeypatch, 4, 4 << 20)
+    assert _loader_digest(small_pipeline["bal"], small_pipeline["vocab"],
+                          **kw) == base
+    monkeypatch.setenv(storage.ENV_VAR, "mock")
+    assert _loader_digest(small_pipeline["bal"], small_pipeline["vocab"],
+                          **kw) == base

@@ -228,6 +228,16 @@ def load_loader_bench(repo_root):
         k: v.get("sustained_samples_per_s") for k, v in sorted(
             configs.items()) if isinstance(v, dict)
     }
+    cache = doc.get("cache_prefetch_speedup") or {}
+    if isinstance(cache, dict) and cache:
+        out["cache_prefetch"] = {
+            "backend_latency_ms": cache.get("backend_latency_ms"),
+            "shards": cache.get("shards"),
+            "prefetch_over_sync": cache.get("prefetch_over_sync"),
+            "prefetch_over_local": cache.get("prefetch_over_local"),
+            "warm_epoch_over_local_epoch": cache.get(
+                "warm_epoch_over_local_epoch"),
+        }
     return out
 
 
@@ -320,6 +330,15 @@ def main(argv=None):
         print("loader schema-v2 speedups: " + ", ".join(
             "{}={}x".format(k, v) for k, v in sorted(
                 loader["schema_v2_over_v1"].items())))
+    if loader and loader.get("cache_prefetch"):
+        c = loader["cache_prefetch"]
+        print("loader shard prefetch+cache (mock store, {}ms/op, {} "
+              "shards): {}x over sync, {}x of local-FS, warm epoch "
+              "{}x local".format(
+                  c.get("backend_latency_ms"), c.get("shards"),
+                  c.get("prefetch_over_sync"),
+                  c.get("prefetch_over_local"),
+                  c.get("warm_epoch_over_local_epoch")))
     if loader and loader.get("packed_offline_over_loadtime"):
         print("offline-packed over load-time packer: " + ", ".join(
             "{}={}x (pad {} vs {})".format(k, v["x"], v["pad_offline"],

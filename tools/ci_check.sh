@@ -111,6 +111,18 @@ if [ "${LDDL_TPU_CI_SMOKE_BENCH:-0}" = "1" ]; then
         echo "ci_check: status smoke FAILED — attribution/window/alert contract broken" >&2
         exit 1
     fi
+    # Loader shard-I/O pipeline smoke: sync vs prefetch+cache (cold and
+    # warm) over a latency-injected mock store. Byte identity is GATING
+    # — prefetch depth and cache budget are scheduling knobs and must
+    # never change a delivered tensor byte; the speedups it prints are
+    # informational (LOADER_BENCH.json cache_prefetch_speedup is the
+    # measurement of record).
+    if JAX_PLATFORMS=cpu python benchmarks/cache_smoke.py; then
+        echo "ci_check: loader prefetch/cache identity smoke OK (speedup non-gating)"
+    else
+        echo "ci_check: cache smoke FAILED — prefetch/cache changed delivered bytes or crash" >&2
+        exit 1
+    fi
 fi
 
 # Opt-in native-engine smoke: builds the C++ engine from source and runs
